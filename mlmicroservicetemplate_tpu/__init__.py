@@ -1,0 +1,33 @@
+"""TPU-native ML inference-serving framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``CodyRichter/MLMicroserviceTemplate`` (an HTTP inference microservice
+template: FastAPI ``/predict`` + ``ModelWrapper.load()`` +
+``InferenceWorker.run_batch()`` + dynamic-batching queue + DataParallel
+replica serving — see ``SURVEY.md`` §1–§3 and ``BASELINE.json``).
+
+Layer map (top → bottom), mirrored in the subpackages:
+
+- ``api``       — aiohttp HTTP surface: ``POST /predict`` (JSON text or
+                  multipart image), streaming seq2seq responses,
+                  ``/healthz`` ``/readyz`` ``/status`` ``/metrics``,
+                  parent-server registration client.
+- ``scheduler`` — asyncio dynamic-batching queue (max-batch / max-wait,
+                  per-request futures).
+- ``engine``    — jit-compiled ``run_batch`` executables with bucketed
+                  static shapes and AOT warmup; single-dispatch scan
+                  decode for seq2seq.
+- ``models``    — pure-function JAX model zoo (ResNet-50, BERT-base,
+                  T5-small) + pre/post-processing + tokenizers.
+- ``parallel``  — device mesh + shard_map replica serving (the TPU-native
+                  answer to NCCL DataParallel: XLA collectives over ICI).
+- ``runtime``   — device discovery, dtype policy, DEVICE=tpu|cpu wiring.
+- ``convert``   — offline torch/safetensors → JAX pytree checkpoint
+                  conversion (the only place torch may be imported).
+- ``ops``       — Pallas TPU kernels for host/device hot ops.
+
+Import discipline: importing this package must never pull in torch
+(enforced by ``tests/test_no_torch.py``).
+"""
+
+__version__ = "0.1.0"

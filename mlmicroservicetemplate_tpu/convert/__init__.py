@@ -1,0 +1,21 @@
+"""Offline checkpoint conversion: HF/torch state dicts → JAX pytrees.
+
+The ONLY place in the framework allowed to touch torch (and even here it
+is optional: the mapping functions operate on ``{name: numpy array}``
+dicts, so safetensors files convert with no torch at all).
+Parity target: ``ModelWrapper.load()`` materializing pretrained
+checkpoints onto the device (BASELINE.json:5) — here the pytree is
+materialized straight into HBM by the runtime with a chosen sharding.
+"""
+
+from .hf_maps import (
+    bert_state_to_pytree,
+    resnet_state_to_pytree,
+    t5_state_to_pytree,
+)
+
+__all__ = [
+    "bert_state_to_pytree",
+    "resnet_state_to_pytree",
+    "t5_state_to_pytree",
+]

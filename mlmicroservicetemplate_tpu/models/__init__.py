@@ -1,0 +1,2 @@
+from . import bert, resnet, t5  # noqa: F401
+from .registry import MODEL_REGISTRY, ModelBundle, build_model  # noqa: F401

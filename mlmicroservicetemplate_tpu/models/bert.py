@@ -1,0 +1,126 @@
+"""BERT-base encoder + sequence-classification head, pure-JAX.
+
+Capability parity: the reference serves an HF BERT-base text classifier
+behind ``/predict`` (BASELINE.json:9). Ground-up JAX implementation of
+the BERT architecture (post-LN transformer encoder, learned positions,
+token-type embeddings, erf-GELU, LN eps 1e-12), HF-checkpoint-mappable
+via ``convert.bert_state_to_pytree``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    Params,
+    dense,
+    dense_init,
+    embed,
+    embedding_init,
+    gelu,
+    layernorm,
+    layernorm_init,
+    merge_heads,
+    mha_attention,
+    split_heads,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    ln_eps: float = 1e-12
+
+
+def init_params(key, cfg: BertConfig = BertConfig()) -> Params:
+    keys = jax.random.split(key, cfg.num_layers + 4)
+    d = cfg.hidden_size
+    params: Params = {
+        "embeddings": {
+            "word": embedding_init(keys[0], cfg.vocab_size, d),
+            "position": embedding_init(keys[1], cfg.max_position, d),
+            "token_type": embedding_init(keys[2], cfg.type_vocab_size, d),
+            "ln": layernorm_init(d),
+        },
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[3 + i], 6)
+        params["layers"].append(
+            {
+                "attn": {
+                    "q": dense_init(k[0], d, d, std=0.02),
+                    "k": dense_init(k[1], d, d, std=0.02),
+                    "v": dense_init(k[2], d, d, std=0.02),
+                    "out": dense_init(k[3], d, d, std=0.02),
+                    "ln": layernorm_init(d),
+                },
+                "mlp": {
+                    "up": dense_init(k[4], d, cfg.intermediate_size, std=0.02),
+                    "down": dense_init(k[5], cfg.intermediate_size, d, std=0.02),
+                    "ln": layernorm_init(d),
+                },
+            }
+        )
+    k_pool, k_cls = jax.random.split(keys[-1])
+    params["pooler"] = dense_init(k_pool, d, d, std=0.02)
+    params["classifier"] = dense_init(k_cls, d, cfg.num_labels, std=0.02)
+    return params
+
+
+def _layer(p: Params, cfg: BertConfig, x: jax.Array, mask: jax.Array) -> jax.Array:
+    a = p["attn"]
+    q = split_heads(dense(a["q"], x), cfg.num_heads)
+    k = split_heads(dense(a["k"], x), cfg.num_heads)
+    v = split_heads(dense(a["v"], x), cfg.num_heads)
+    ctx = merge_heads(mha_attention(q, k, v, mask=mask))
+    x = layernorm(a["ln"], x + dense(a["out"], ctx), eps=cfg.ln_eps)
+    m = p["mlp"]
+    h = dense(m["down"], gelu(dense(m["up"], x)))
+    return layernorm(m["ln"], x + h, eps=cfg.ln_eps)
+
+
+def encode(
+    params: Params,
+    cfg: BertConfig,
+    input_ids: jax.Array,  # [B, S] int32
+    attention_mask: jax.Array,  # [B, S] 1=keep
+    token_type_ids: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Returns the final hidden states [B, S, D]."""
+    b, s = input_ids.shape
+    e = params["embeddings"]
+    x = embed(e["word"], input_ids, dtype)
+    x = x + embed(e["position"], jnp.arange(s, dtype=jnp.int32), dtype)[None]
+    tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+    x = x + embed(e["token_type"], tt, dtype)
+    x = layernorm(e["ln"], x, eps=cfg.ln_eps)
+    mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S]
+    for layer in params["layers"]:
+        x = _layer(layer, cfg, x, mask)
+    return x
+
+
+def classify(
+    params: Params,
+    cfg: BertConfig,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    token_type_ids: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Sequence classification logits [B, num_labels] in f32 (the serving path)."""
+    hidden = encode(params, cfg, input_ids, attention_mask, token_type_ids, dtype)
+    pooled = jnp.tanh(dense(params["pooler"], hidden[:, 0]).astype(jnp.float32))
+    return dense(params["classifier"], pooled.astype(jnp.float32))

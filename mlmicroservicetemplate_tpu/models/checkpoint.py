@@ -1,0 +1,55 @@
+"""Checkpoint IO: load converted pytrees / convert HF state dicts on the fly.
+
+Parity with ``ModelWrapper.load()`` (BASELINE.json:5). Formats:
+
+- directory       → orbax checkpoint of an already-converted pytree (the
+                    warm-start cache: conversion runs once, restores are
+                    straight bytes→HBM).
+- ``*.safetensors`` → HF state dict, converted via the model's map
+                    (no torch involved).
+- ``*.npz``        → HF state dict as numpy archive, converted likewise.
+- ``*.bin``/``*.pt`` → torch state dict; torch imported HERE only, lazily
+                    (keeps torch off the serving import path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import numpy as np
+
+
+def load_state_dict(path: str) -> dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    if path.endswith((".bin", ".pt", ".pth")):
+        import torch  # offline conversion only — never on the serving path
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise ValueError(f"unrecognized checkpoint format: {path}")
+
+
+def load_pytree(path: str, converter: Callable[[dict], dict]):
+    """Path → param pytree (device arrays committed by the caller/runtime)."""
+    if os.path.isdir(path):
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(os.path.abspath(path))
+    state = load_state_dict(path)
+    return converter(state)
+
+
+def save_pytree(path: str, pytree) -> None:
+    """Cache a converted pytree with orbax for fast warm starts."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), pytree, force=True)

@@ -1,0 +1,209 @@
+"""Shared pure-function building blocks for the JAX model zoo.
+
+Design: every model is (init_params, apply) over a plain nested-dict
+pytree — no module framework. Pure functions keep the whole forward pass
+inside one jit trace (single XLA executable per shape bucket), make
+params trivially shardable with ``jax.sharding`` (any leaf can carry a
+NamedSharding), and keep checkpoint conversion a dumb dict mapping.
+
+Layout conventions (TPU-first):
+- images NHWC, conv kernels HWIO (XLA's native TPU layouts; the
+  reference's NCHW/OIHW torch layouts are converted at checkpoint load).
+- attention activations [B, S, H, D]; matmuls via einsum so XLA fuses
+  and tiles them onto the MXU.
+- params stored in ``param_dtype`` (bf16 on TPU), compute in
+  ``compute_dtype``, logits returned in f32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# initializers
+
+
+def _fan_in_out(shape: tuple[int, ...]) -> tuple[int, int]:
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv HWIO: receptive * in, receptive * out
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def kaiming_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fan_in_out(shape)
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fan_in_out(shape)
+    a = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def normal_init(key, shape, dtype=jnp.float32, std=0.02):
+    return jax.random.normal(key, shape, dtype) * std
+
+
+# ---------------------------------------------------------------------------
+# primitive layers
+
+
+def dense_init(key, d_in: int, d_out: int, bias: bool = True, std: float | None = None):
+    kw, _ = jax.random.split(key)
+    if std is None:
+        w = xavier_uniform(kw, (d_in, d_out))
+    else:
+        w = normal_init(kw, (d_in, d_out), std=std)
+    p: Params = {"kernel": w}
+    if bias:
+        p["bias"] = jnp.zeros((d_out,))
+    return p
+
+
+def dense(p: Params, x: jax.Array) -> jax.Array:
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def conv_init(key, kh: int, kw: int, c_in: int, c_out: int):
+    return {"kernel": kaiming_normal(key, (kh, kw, c_in, c_out))}
+
+
+def conv2d(p: Params, x: jax.Array, stride: int = 1, padding="SAME") -> jax.Array:
+    """NHWC conv with HWIO kernel — the MXU-friendly layout."""
+    return lax.conv_general_dilated(
+        x,
+        p["kernel"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def batchnorm_init(c: int):
+    """Inference-mode BN state (running stats + affine)."""
+    return {
+        "scale": jnp.ones((c,)),
+        "bias": jnp.zeros((c,)),
+        "mean": jnp.zeros((c,)),
+        "var": jnp.ones((c,)),
+    }
+
+
+def batchnorm(p: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Inference BN as a single fused affine: y = x * g + b.
+
+    The rescale is precomputed in f32 (rsqrt of running var) then cast,
+    so bf16 activations see one multiply-add — XLA fuses this into the
+    preceding conv's epilogue.
+    """
+    g = (p["scale"] * lax.rsqrt(p["var"] + eps)).astype(x.dtype)
+    b = (p["bias"] - p["mean"] * p["scale"] * lax.rsqrt(p["var"] + eps)).astype(x.dtype)
+    return x * g + b
+
+
+def layernorm_init(d: int):
+    return {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}
+
+
+def layernorm(p: Params, x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    # Normalize in f32 for numerical stability, cast back for the MXU.
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rmsnorm_init(d: int):
+    return {"scale": jnp.ones((d,))}
+
+
+def rmsnorm(p: Params, x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """T5-style LayerNorm: no mean subtraction, no bias."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps) * p["scale"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def embedding_init(key, vocab: int, d: int, std: float = 0.02):
+    return {"embedding": normal_init(key, (vocab, d), std=std)}
+
+
+def embed(p: Params, ids: jax.Array, dtype=None) -> jax.Array:
+    t = p["embedding"]
+    if dtype is not None:
+        t = t.astype(dtype)
+    return jnp.take(t, ids, axis=0)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    # erf-based gelu (matches torch nn.GELU default / BERT "gelu").
+    return jax.nn.gelu(x, approximate=False)
+
+
+# ---------------------------------------------------------------------------
+# attention
+
+
+def mha_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, H, D]
+    v: jax.Array,  # [B, Sk, H, D]
+    mask: jax.Array | None = None,  # broadcastable to [B, H, Sq, Sk]
+    bias: jax.Array | None = None,  # additive, broadcastable to [B, H, Sq, Sk]
+    scale: float | None = None,
+) -> jax.Array:
+    """Batched multi-head attention core; returns [B, Sq, H, D].
+
+    Softmax runs in f32 regardless of activation dtype. The two einsums
+    are the MXU work; XLA fuses mask/bias/softmax between them.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e9))
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    b, s, d = x.shape
+    return x.reshape(b, s, n_heads, d // n_heads)
+
+
+def merge_heads(x: jax.Array) -> jax.Array:
+    b, s, h, d = x.shape
+    return x.reshape(b, s, h * d)
+
+
+def count_params(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def cast_pytree(params: Params, dtype) -> Params:
+    """Cast all floating leaves to ``dtype`` (int leaves untouched)."""
+    def _cast(p):
+        if jnp.issubdtype(p.dtype, jnp.floating):
+            return p.astype(dtype)
+        return p
+
+    return jax.tree.map(_cast, params)

@@ -1,0 +1,228 @@
+"""Model registry: name → loaded, servable ModelBundle.
+
+This is the TPU-native answer to the reference's ``ModelWrapper.load()``
+(BASELINE.json:5): selecting a model materializes its params as a JAX
+pytree (from a converted checkpoint when ``MODEL_PATH`` is set, else
+deterministic random init — no network/HF hub here, SURVEY.md §7.1),
+binds host-side pre/post-processing, and exposes jittable device
+functions for the engine to compile per shape bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Any, Callable
+
+import numpy as np
+
+from ..runtime.device import DtypePolicy
+from . import bert as bert_mod
+from . import resnet as resnet_mod
+from . import t5 as t5_mod
+from .preprocess import decode_image, load_labels, softmax_np, topk_np
+from .tokenizer import build_tokenizer
+
+log = logging.getLogger(__name__)
+
+KIND_IMAGE = "image_classification"
+KIND_TEXT = "text_classification"
+KIND_SEQ2SEQ = "seq2seq"
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    """Everything the engine/scheduler/API need to serve one model."""
+
+    name: str
+    kind: str
+    cfg: Any
+    params: Any  # device pytree
+    policy: DtypePolicy
+    tokenizer: Any | None
+    labels: list[str] | None
+    # Jittable: (params, *batch arrays) -> outputs. Engine owns jit+buckets.
+    forward: Callable | None
+    # seq2seq trio (jittable): encode, init_decode_state, generate_chunk.
+    encode_fn: Callable | None = None
+    init_state_fn: Callable | None = None
+    generate_chunk_fn: Callable | None = None
+    image_size: int = 224
+
+    # -- host-side single-item pre/post ------------------------------------
+    def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
+        if self.kind == KIND_IMAGE:
+            if item.image is None:
+                raise ValueError("this model expects an image payload")
+            return {"image": decode_image(item.image, self.image_size)}
+        if item.text is None:
+            raise ValueError("this model expects a text payload")
+        max_len = self.cfg.max_position if hasattr(self.cfg, "max_position") else 512
+        ids, mask = self.tokenizer.encode(item.text, max_len)
+        n = int(mask.sum())
+        return {"input_ids": ids[:n], "length": np.int32(n)}
+
+    def postprocess(self, row: np.ndarray) -> dict:
+        if self.kind == KIND_IMAGE:
+            idx, probs = topk_np(row[None], k=5)
+            top = [
+                {
+                    "class_id": int(i),
+                    "score": round(float(p), 6),
+                    **({"label": self.labels[int(i)]} if self.labels else {}),
+                }
+                for i, p in zip(idx[0], probs[0])
+            ]
+            return {"prediction": top[0], "topk": top}
+        if self.kind == KIND_TEXT:
+            probs = softmax_np(row)
+            label_id = int(np.argmax(probs))
+            return {
+                "prediction": {
+                    "label_id": label_id,
+                    **({"label": self.labels[label_id]} if self.labels else {}),
+                    "score": round(float(probs[label_id]), 6),
+                },
+                "probs": [round(float(p), 6) for p in probs],
+            }
+        # seq2seq: row is a token id vector.
+        return {"prediction": {"text": self.tokenizer.decode(row)}}
+
+
+@dataclasses.dataclass
+class RawItem:
+    """One unparsed /predict payload."""
+
+    text: str | None = None
+    image: bytes | None = None
+    stream: bool = False
+
+
+# ---------------------------------------------------------------------------
+# builders
+
+
+def _load_or_init(name: str, model_path: str | None, init_fn, converter):
+    """Load converted checkpoint if given, else deterministic random init."""
+    import jax
+
+    if model_path:
+        from .checkpoint import load_pytree
+
+        log.info("loading %s checkpoint from %s", name, model_path)
+        return load_pytree(model_path, converter)
+    log.info("no MODEL_PATH for %s — deterministic random init", name)
+    return init_fn(jax.random.PRNGKey(0))
+
+
+def _build_resnet(svc_cfg, policy: DtypePolicy) -> ModelBundle:
+    from ..convert import resnet_state_to_pytree
+    from .common import cast_pytree
+
+    cfg = resnet_mod.ResNetConfig()
+    params = _load_or_init("resnet50", svc_cfg.model_path,
+                           functools.partial(resnet_mod.init_params, cfg=cfg),
+                           resnet_state_to_pytree)
+    params = cast_pytree(params, policy.param_jnp)
+
+    def forward(p, images):
+        return resnet_mod.apply(p, cfg, images.astype(policy.compute_jnp))
+
+    return ModelBundle(
+        name="resnet50",
+        kind=KIND_IMAGE,
+        cfg=cfg,
+        params=params,
+        policy=policy,
+        tokenizer=None,
+        labels=load_labels(getattr(svc_cfg, "labels_path", None)),
+        forward=forward,
+        image_size=cfg.image_size,
+    )
+
+
+def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
+    from ..convert import bert_state_to_pytree
+    from .common import cast_pytree
+
+    cfg = bert_mod.BertConfig()
+    params = _load_or_init("bert-base", svc_cfg.model_path,
+                           functools.partial(bert_mod.init_params, cfg=cfg),
+                           bert_state_to_pytree)
+    params = cast_pytree(params, policy.param_jnp)
+
+    def forward(p, input_ids, attention_mask):
+        return bert_mod.classify(
+            p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp
+        )
+
+    return ModelBundle(
+        name="bert-base",
+        kind=KIND_TEXT,
+        cfg=cfg,
+        params=params,
+        policy=policy,
+        tokenizer=build_tokenizer(svc_cfg.tokenizer_path, for_t5=False),
+        labels=load_labels(getattr(svc_cfg, "labels_path", None)),
+        forward=forward,
+    )
+
+
+def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
+    from ..convert import t5_state_to_pytree
+    from .common import cast_pytree
+
+    cfg = t5_mod.T5Config()
+    params = _load_or_init("t5-small", svc_cfg.model_path,
+                           functools.partial(t5_mod.init_params, cfg=cfg),
+                           t5_state_to_pytree)
+    params = cast_pytree(params, policy.param_jnp)
+
+    def encode_fn(p, input_ids, attention_mask):
+        return t5_mod.encode(p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp)
+
+    def init_state_fn(p, enc_out, enc_mask, max_len: int):
+        return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len)
+
+    def generate_chunk_fn(p, state, n_steps: int):
+        return t5_mod.generate_chunk(p, cfg, state, n_steps)
+
+    return ModelBundle(
+        name="t5-small",
+        kind=KIND_SEQ2SEQ,
+        cfg=cfg,
+        params=params,
+        policy=policy,
+        tokenizer=build_tokenizer(svc_cfg.tokenizer_path, for_t5=True),
+        labels=None,
+        forward=None,
+        encode_fn=encode_fn,
+        init_state_fn=init_state_fn,
+        generate_chunk_fn=generate_chunk_fn,
+    )
+
+
+MODEL_REGISTRY: dict[str, Callable] = {
+    "resnet50": _build_resnet,
+    "bert-base": _build_bert,
+    "t5-small": _build_t5,
+}
+# Aliases for HF-style names the reference's configs use.
+MODEL_REGISTRY["resnet-50"] = _build_resnet
+MODEL_REGISTRY["bert-base-uncased"] = _build_bert
+MODEL_REGISTRY["t5small"] = _build_t5
+
+
+def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
+    if policy is None:
+        from ..runtime.device import default_policy
+
+        policy = default_policy(svc_cfg.device)
+    try:
+        builder = MODEL_REGISTRY[svc_cfg.model_name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {svc_cfg.model_name!r}; available: {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return builder(svc_cfg, policy)

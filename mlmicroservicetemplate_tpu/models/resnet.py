@@ -1,0 +1,131 @@
+"""ResNet-50 image classifier, pure-JAX, NHWC/HWIO (TPU-native layouts).
+
+Capability parity: the reference serves a torchvision/HF ResNet-50
+ImageNet classifier behind ``/predict`` (BASELINE.json:8). This is a
+ground-up JAX implementation of the same architecture (ResNet v1.5:
+stride on the 3x3 bottleneck conv, matching torchvision and HF
+``ResNetForImageClassification`` with default config), structured so HF
+checkpoints map 1:1 onto the param pytree via ``convert/``.
+
+Inference-only: BatchNorm applies running stats as a fused affine
+(``common.batchnorm``), which XLA folds into the conv epilogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .common import (
+    Params,
+    batchnorm,
+    batchnorm_init,
+    conv2d,
+    conv_init,
+    dense,
+    dense_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    embedding_size: int = 64
+    hidden_sizes: tuple[int, ...] = (256, 512, 1024, 2048)
+    depths: tuple[int, ...] = (3, 4, 6, 3)
+    num_labels: int = 1000
+    downsample_in_first_stage: bool = False
+    image_size: int = 224
+    reduction: int = 4
+
+
+def _bottleneck_init(key, c_in: int, c_out: int, stride: int, reduction: int) -> Params:
+    c_mid = c_out // reduction
+    keys = jax.random.split(key, 4)
+    p: Params = {
+        "conv1": conv_init(keys[0], 1, 1, c_in, c_mid),
+        "bn1": batchnorm_init(c_mid),
+        "conv2": conv_init(keys[1], 3, 3, c_mid, c_mid),
+        "bn2": batchnorm_init(c_mid),
+        "conv3": conv_init(keys[2], 1, 1, c_mid, c_out),
+        "bn3": batchnorm_init(c_out),
+    }
+    if c_in != c_out or stride != 1:
+        p["shortcut"] = {
+            "conv": conv_init(keys[3], 1, 1, c_in, c_out),
+            "bn": batchnorm_init(c_out),
+        }
+    return p
+
+
+def _bottleneck_apply(p: Params, x: jax.Array, stride: int) -> jax.Array:
+    residual = x
+    if "shortcut" in p:
+        residual = conv2d(p["shortcut"]["conv"], x, stride=stride, padding="VALID")
+        residual = batchnorm(p["shortcut"]["bn"], residual)
+    y = conv2d(p["conv1"], x, stride=1, padding="VALID")
+    y = jax.nn.relu(batchnorm(p["bn1"], y))
+    # v1.5: the spatial downsample lives on the 3x3 conv.
+    y = conv2d(p["conv2"], y, stride=stride, padding=((1, 1), (1, 1)))
+    y = jax.nn.relu(batchnorm(p["bn2"], y))
+    y = conv2d(p["conv3"], y, stride=1, padding="VALID")
+    y = batchnorm(p["bn3"], y)
+    return jax.nn.relu(y + residual)
+
+
+def _stage_strides(cfg: ResNetConfig) -> list[int]:
+    first = 2 if cfg.downsample_in_first_stage else 1
+    return [first] + [2] * (len(cfg.depths) - 1)
+
+
+def init_params(key, cfg: ResNetConfig = ResNetConfig()) -> Params:
+    k_embed, k_stages, k_cls = jax.random.split(key, 3)
+    params: Params = {
+        "embedder": {
+            "conv": conv_init(k_embed, 7, 7, 3, cfg.embedding_size),
+            "bn": batchnorm_init(cfg.embedding_size),
+        }
+    }
+    stages = []
+    c_in = cfg.embedding_size
+    stage_keys = jax.random.split(k_stages, len(cfg.depths))
+    for si, (depth, c_out, stride) in enumerate(
+        zip(cfg.depths, cfg.hidden_sizes, _stage_strides(cfg))
+    ):
+        blocks = []
+        block_keys = jax.random.split(stage_keys[si], depth)
+        for bi in range(depth):
+            s = stride if bi == 0 else 1
+            blocks.append(_bottleneck_init(block_keys[bi], c_in, c_out, s, cfg.reduction))
+            c_in = c_out
+        stages.append(blocks)
+    params["stages"] = stages
+    params["classifier"] = dense_init(k_cls, cfg.hidden_sizes[-1], cfg.num_labels)
+    return params
+
+
+def _max_pool_3x3_s2(x: jax.Array) -> jax.Array:
+    # torch MaxPool2d(kernel=3, stride=2, padding=1) equivalent.
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 3, 3, 1),
+        window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+
+def apply(params: Params, cfg: ResNetConfig, images: jax.Array) -> jax.Array:
+    """images: [B, H, W, 3] float (already normalized) → logits [B, labels] f32."""
+    x = conv2d(params["embedder"]["conv"], images, stride=2, padding=((3, 3), (3, 3)))
+    x = jax.nn.relu(batchnorm(params["embedder"]["bn"], x))
+    x = _max_pool_3x3_s2(x)
+    for blocks, stride in zip(params["stages"], _stage_strides(cfg)):
+        for bi, block in enumerate(blocks):
+            x = _bottleneck_apply(block, x, stride if bi == 0 else 1)
+    # Global average pool → classifier; logits in f32 for exact argmax.
+    pooled = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return dense(params["classifier"], pooled)

@@ -1,0 +1,330 @@
+"""T5-small encoder-decoder, pure-JAX, with KV-cached incremental decode.
+
+Capability parity: the reference streams seq2seq generations (T5-small
+summarization) through ``/predict`` (BASELINE.json:12). This is a
+ground-up JAX implementation of the T5 architecture: pre-LN blocks with
+RMSNorm, relative-position-bucket attention bias (shared from layer 0),
+unscaled dot-product attention, ReLU feed-forward, tied lm_head with
+d_model**-0.5 output scaling.
+
+TPU-first decode design (SURVEY.md §7.4.2): generation runs as a
+``lax.scan`` over decode steps inside ONE jit — static-shape KV caches
+sized to ``max_decode_len``, no per-token Python dispatch. Streaming is
+chunked: the engine calls ``generate_chunk`` (one dispatch per K tokens)
+and forwards tokens to the HTTP layer between chunks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .common import (
+    Params,
+    dense,
+    dense_init,
+    embed,
+    merge_heads,
+    mha_attention,
+    normal_init,
+    rmsnorm,
+    rmsnorm_init,
+    split_heads,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    num_heads: int = 8
+    d_ff: int = 2048
+    num_layers: int = 6
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    pad_id: int = 0
+    eos_id: int = 1
+    decoder_start_id: int = 0
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.d_kv
+
+
+# ---------------------------------------------------------------------------
+# init
+
+
+def _attn_init(key, cfg: T5Config, with_rel_bias: bool) -> Params:
+    keys = jax.random.split(key, 5)
+    d, inner = cfg.d_model, cfg.inner_dim
+    p: Params = {
+        "q": dense_init(keys[0], d, inner, bias=False, std=(d * cfg.d_kv) ** -0.5),
+        "k": dense_init(keys[1], d, inner, bias=False, std=d**-0.5),
+        "v": dense_init(keys[2], d, inner, bias=False, std=d**-0.5),
+        "out": dense_init(keys[3], inner, d, bias=False, std=inner**-0.5),
+    }
+    if with_rel_bias:
+        p["rel_bias"] = {
+            "embedding": normal_init(keys[4], (cfg.rel_buckets, cfg.num_heads), std=d**-0.5)
+        }
+    return p
+
+
+def _mlp_init(key, cfg: T5Config) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {
+        "wi": dense_init(k1, cfg.d_model, cfg.d_ff, bias=False, std=cfg.d_model**-0.5),
+        "wo": dense_init(k2, cfg.d_ff, cfg.d_model, bias=False, std=cfg.d_ff**-0.5),
+    }
+
+
+def init_params(key, cfg: T5Config = T5Config()) -> Params:
+    keys = jax.random.split(key, 2 * cfg.num_layers + 2)
+    params: Params = {
+        "shared": {"embedding": normal_init(keys[0], (cfg.vocab_size, cfg.d_model), std=1.0)},
+        "encoder": {"layers": [], "final_ln": rmsnorm_init(cfg.d_model)},
+        "decoder": {"layers": [], "final_ln": rmsnorm_init(cfg.d_model)},
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[1 + i], 2)
+        params["encoder"]["layers"].append(
+            {
+                "attn": _attn_init(k[0], cfg, with_rel_bias=(i == 0)),
+                "attn_ln": rmsnorm_init(cfg.d_model),
+                "mlp": _mlp_init(k[1], cfg),
+                "mlp_ln": rmsnorm_init(cfg.d_model),
+            }
+        )
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[1 + cfg.num_layers + i], 3)
+        params["decoder"]["layers"].append(
+            {
+                "self_attn": _attn_init(k[0], cfg, with_rel_bias=(i == 0)),
+                "self_attn_ln": rmsnorm_init(cfg.d_model),
+                "cross_attn": _attn_init(k[1], cfg, with_rel_bias=False),
+                "cross_attn_ln": rmsnorm_init(cfg.d_model),
+                "mlp": _mlp_init(k[2], cfg),
+                "mlp_ln": rmsnorm_init(cfg.d_model),
+            }
+        )
+    return params
+
+
+# ---------------------------------------------------------------------------
+# relative position bias
+
+
+def _relative_bucket(rel: jax.Array, bidirectional: bool, num_buckets: int, max_dist: int):
+    ret = jnp.zeros_like(rel)
+    n = num_buckets
+    if bidirectional:
+        n //= 2
+        ret = ret + (rel > 0).astype(rel.dtype) * n
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    rel_f = jnp.maximum(rel.astype(jnp.float32), 1.0)
+    val_if_large = max_exact + (
+        jnp.log(rel_f / max_exact)
+        / jnp.log(max_dist / max_exact)
+        * (n - max_exact)
+    ).astype(rel.dtype)
+    val_if_large = jnp.minimum(val_if_large, n - 1)
+    return ret + jnp.where(is_small, rel, val_if_large)
+
+
+def _position_bias(
+    rel_bias: Params,
+    cfg: T5Config,
+    q_pos: jax.Array,  # [Sq] int32
+    k_pos: jax.Array,  # [Sk] int32
+    bidirectional: bool,
+) -> jax.Array:
+    """[1, H, Sq, Sk] additive attention bias from bucketed relative positions."""
+    rel = k_pos[None, :] - q_pos[:, None]  # [Sq, Sk]
+    buckets = _relative_bucket(rel, bidirectional, cfg.rel_buckets, cfg.rel_max_distance)
+    bias = embed(rel_bias, buckets)  # [Sq, Sk, H]
+    return jnp.transpose(bias, (2, 0, 1))[None]
+
+
+# ---------------------------------------------------------------------------
+# blocks
+
+
+def _self_attention(p, cfg, x, mask, bias):
+    q = split_heads(dense(p["q"], x), cfg.num_heads)
+    k = split_heads(dense(p["k"], x), cfg.num_heads)
+    v = split_heads(dense(p["v"], x), cfg.num_heads)
+    # T5 folds the 1/sqrt(d) into init: scale=1.
+    ctx = mha_attention(q, k, v, mask=mask, bias=bias, scale=1.0)
+    return dense(p["out"], merge_heads(ctx))
+
+
+def encode(
+    params: Params,
+    cfg: T5Config,
+    input_ids: jax.Array,  # [B, S]
+    attention_mask: jax.Array,  # [B, S]
+    dtype=jnp.float32,
+) -> jax.Array:
+    s = input_ids.shape[1]
+    x = embed(params["shared"], input_ids, dtype)
+    mask = attention_mask[:, None, None, :].astype(bool)
+    pos = jnp.arange(s, dtype=jnp.int32)
+    bias = _position_bias(
+        params["encoder"]["layers"][0]["attn"]["rel_bias"], cfg, pos, pos, bidirectional=True
+    )
+    for layer in params["encoder"]["layers"]:
+        h = rmsnorm(layer["attn_ln"], x)
+        x = x + _self_attention(layer["attn"], cfg, h, mask, bias)
+        h = rmsnorm(layer["mlp_ln"], x)
+        h = dense(layer["mlp"]["wo"], jax.nn.relu(dense(layer["mlp"]["wi"], h)))
+        x = x + h
+    return rmsnorm(params["encoder"]["final_ln"], x)
+
+
+class DecodeState(NamedTuple):
+    """Static-shape incremental decode state (everything lives on device)."""
+
+    cache_k: Any  # list of [B, Tmax, H, D] per decoder layer
+    cache_v: Any
+    cross_k: Any  # list of [B, Senc, H, D] — precomputed once
+    cross_v: Any
+    enc_mask: jax.Array  # [B, Senc]
+    pos: jax.Array  # [] int32 — next position to write
+    last_token: jax.Array  # [B] int32
+    done: jax.Array  # [B] bool
+    tokens: jax.Array  # [B, Tmax] int32 — generated so far (pad-filled)
+
+
+def init_decode_state(
+    params: Params,
+    cfg: T5Config,
+    enc_out: jax.Array,  # [B, Senc, D]
+    enc_mask: jax.Array,  # [B, Senc]
+    max_len: int,
+) -> DecodeState:
+    b = enc_out.shape[0]
+    dtype = enc_out.dtype
+    cache_k, cache_v, cross_k, cross_v = [], [], [], []
+    for layer in params["decoder"]["layers"]:
+        cache_k.append(jnp.zeros((b, max_len, cfg.num_heads, cfg.d_kv), dtype))
+        cache_v.append(jnp.zeros((b, max_len, cfg.num_heads, cfg.d_kv), dtype))
+        ca = layer["cross_attn"]
+        cross_k.append(split_heads(dense(ca["k"], enc_out), cfg.num_heads))
+        cross_v.append(split_heads(dense(ca["v"], enc_out), cfg.num_heads))
+    return DecodeState(
+        cache_k=cache_k,
+        cache_v=cache_v,
+        cross_k=cross_k,
+        cross_v=cross_v,
+        enc_mask=enc_mask,
+        pos=jnp.int32(0),
+        last_token=jnp.full((b,), cfg.decoder_start_id, jnp.int32),
+        done=jnp.zeros((b,), bool),
+        tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+    )
+
+
+def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[DecodeState, jax.Array]:
+    """One greedy decode step; returns (new_state, emitted token [B])."""
+    dtype = state.cross_k[0].dtype
+    max_len = state.tokens.shape[1]
+    x = embed(params["shared"], state.last_token[:, None], dtype)  # [B,1,D]
+    t = state.pos
+    k_pos = jnp.arange(max_len, dtype=jnp.int32)
+    # Causal-with-cache mask: attend to positions <= t.
+    self_mask = (k_pos <= t)[None, None, None, :]
+    rel = params["decoder"]["layers"][0]["self_attn"]["rel_bias"]
+    self_bias = _position_bias(rel, cfg, t[None], k_pos, bidirectional=False)
+    cross_mask = state.enc_mask[:, None, None, :].astype(bool)
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["decoder"]["layers"]):
+        sa = layer["self_attn"]
+        h = rmsnorm(layer["self_attn_ln"], x)
+        q = split_heads(dense(sa["q"], h), cfg.num_heads)  # [B,1,H,D]
+        k1 = split_heads(dense(sa["k"], h), cfg.num_heads)
+        v1 = split_heads(dense(sa["v"], h), cfg.num_heads)
+        ck = lax.dynamic_update_slice_in_dim(state.cache_k[li], k1, t, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(state.cache_v[li], v1, t, axis=1)
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(q, ck, cv, mask=self_mask, bias=self_bias, scale=1.0)
+        x = x + dense(sa["out"], merge_heads(ctx))
+
+        ca = layer["cross_attn"]
+        h = rmsnorm(layer["cross_attn_ln"], x)
+        qc = split_heads(dense(ca["q"], h), cfg.num_heads)
+        ctx = mha_attention(qc, state.cross_k[li], state.cross_v[li], mask=cross_mask, scale=1.0)
+        x = x + dense(ca["out"], merge_heads(ctx))
+
+        h = rmsnorm(layer["mlp_ln"], x)
+        h = dense(layer["mlp"]["wo"], jax.nn.relu(dense(layer["mlp"]["wi"], h)))
+        x = x + h
+
+    x = rmsnorm(params["decoder"]["final_ln"], x)
+    # Tied lm_head with T5's d_model**-0.5 output scale; logits in f32.
+    x = x * (cfg.d_model**-0.5)
+    lm = params.get("lm_head", params["shared"])
+    w = lm["kernel"] if "kernel" in lm else lm["embedding"].T
+    logits = (x[:, 0].astype(jnp.float32)) @ w.astype(jnp.float32)
+
+    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
+    done = state.done | (next_tok == cfg.eos_id)
+    tokens = lax.dynamic_update_slice_in_dim(
+        state.tokens, next_tok[:, None], t, axis=1
+    )
+    new_state = DecodeState(
+        cache_k=new_k,
+        cache_v=new_v,
+        cross_k=state.cross_k,
+        cross_v=state.cross_v,
+        enc_mask=state.enc_mask,
+        pos=t + 1,
+        last_token=next_tok,
+        done=done,
+        tokens=tokens,
+    )
+    return new_state, next_tok
+
+
+def generate_chunk(
+    params: Params, cfg: T5Config, state: DecodeState, n_steps: int
+) -> tuple[DecodeState, jax.Array]:
+    """Run ``n_steps`` greedy decode steps in ONE compiled scan.
+
+    Returns (state, chunk_tokens [B, n_steps]). The engine jits this per
+    chunk size; streaming granularity = n_steps tokens per dispatch.
+    """
+
+    def step(s, _):
+        s, tok = _decode_step(params, cfg, s)
+        return s, tok
+
+    state, toks = lax.scan(step, state, None, length=n_steps)
+    return state, jnp.transpose(toks)  # [B, n_steps]
+
+
+def greedy_generate(
+    params: Params,
+    cfg: T5Config,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    max_len: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Non-streaming generate: encode + full scan, single dispatch. [B, max_len]."""
+    enc = encode(params, cfg, input_ids, attention_mask, dtype)
+    state = init_decode_state(params, cfg, enc, attention_mask, max_len)
+    state, _ = generate_chunk(params, cfg, state, max_len)
+    return state.tokens

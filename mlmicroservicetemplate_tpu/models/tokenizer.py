@@ -1,0 +1,183 @@
+"""Tokenizers for the text models — pure Python, zero external assets.
+
+Capability parity: the reference's ``ModelWrapper`` owns tokenization via
+HF AutoTokenizer (SURVEY.md §2). This environment has no network and no
+HF cache (SURVEY.md §7.1), so the framework ships:
+
+- ``WordPieceTokenizer`` — full WordPiece (BERT-style: basic tokenize →
+  greedy longest-match subwords), loading a standard ``vocab.txt`` when
+  the operator provides one (``TOKENIZER_PATH``).
+- ``ByteTokenizer`` — deterministic byte-level fallback needing no
+  assets; ids = byte + offset, with pad/unk/cls/sep/eos specials laid
+  out to fit inside the BERT (30522) and T5 (32128) vocab spaces.
+
+Both expose the same interface: ``encode(text, max_len) -> (ids, mask)``
+and ``decode(ids) -> text``.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: token = byte value + offset. No assets.
+
+    Layout (T5-compatible specials): pad=0, eos=1, unk=2, cls=3, sep=4,
+    bytes at 5..260.
+    """
+
+    pad_id = 0
+    eos_id = 1
+    unk_id = 2
+    cls_id = 3
+    sep_id = 4
+    _byte_offset = 5
+
+    def __init__(self, add_cls_sep: bool = False, add_eos: bool = False):
+        self.add_cls_sep = add_cls_sep
+        self.add_eos = add_eos
+
+    @property
+    def vocab_size(self) -> int:
+        return self._byte_offset + 256
+
+    def encode(self, text: str, max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        raw = list(text.encode("utf-8"))
+        specials = (2 if self.add_cls_sep else 0) + (1 if self.add_eos else 0)
+        raw = raw[: max_len - specials]
+        ids = [b + self._byte_offset for b in raw]
+        if self.add_cls_sep:
+            ids = [self.cls_id] + ids + [self.sep_id]
+        if self.add_eos:
+            ids = ids + [self.eos_id]
+        n = len(ids)
+        out = np.full((max_len,), self.pad_id, np.int32)
+        out[:n] = ids
+        mask = np.zeros((max_len,), np.int32)
+        mask[:n] = 1
+        return out, mask
+
+    def decode(self, ids) -> str:
+        bs = bytearray()
+        for i in ids:
+            i = int(i)
+            if i == self.eos_id:
+                break
+            if i >= self._byte_offset:
+                bs.append(i - self._byte_offset)
+        return bs.decode("utf-8", errors="replace")
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+class WordPieceTokenizer:
+    """BERT-style WordPiece over a standard ``vocab.txt`` file."""
+
+    def __init__(self, vocab_path: str, lowercase: bool = True, max_chars_per_word: int = 100):
+        with open(vocab_path, encoding="utf-8") as f:
+            tokens = [line.rstrip("\n") for line in f]
+        self.vocab = {t: i for i, t in enumerate(tokens)}
+        self.inv_vocab = tokens
+        self.lowercase = lowercase
+        self.max_chars_per_word = max_chars_per_word
+        self.pad_id = self.vocab.get("[PAD]", 0)
+        self.unk_id = self.vocab.get("[UNK]", 100)
+        self.cls_id = self.vocab.get("[CLS]", 101)
+        self.sep_id = self.vocab.get("[SEP]", 102)
+        self.eos_id = self.sep_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.inv_vocab)
+
+    def _basic_tokenize(self, text: str) -> list[str]:
+        text = unicodedata.normalize("NFC", text)
+        if self.lowercase:
+            text = text.lower()
+            text = "".join(
+                c for c in unicodedata.normalize("NFD", text)
+                if unicodedata.category(c) != "Mn"
+            )
+        out: list[str] = []
+        word = []
+        for ch in text:
+            if ch.isspace():
+                if word:
+                    out.append("".join(word))
+                    word = []
+            elif _is_punct(ch):
+                if word:
+                    out.append("".join(word))
+                    word = []
+                out.append(ch)
+            else:
+                word.append(ch)
+        if word:
+            out.append("".join(word))
+        return out
+
+    def _wordpiece(self, word: str) -> list[int]:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_id]
+        ids: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = self.vocab[sub]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str, max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        ids: list[int] = [self.cls_id]
+        for w in self._basic_tokenize(text):
+            ids.extend(self._wordpiece(w))
+            if len(ids) >= max_len - 1:
+                break
+        ids = ids[: max_len - 1] + [self.sep_id]
+        n = len(ids)
+        out = np.full((max_len,), self.pad_id, np.int32)
+        out[:n] = ids
+        mask = np.zeros((max_len,), np.int32)
+        mask[:n] = 1
+        return out, mask
+
+    def decode(self, ids) -> str:
+        toks = []
+        for i in ids:
+            i = int(i)
+            if i in (self.pad_id, self.cls_id):
+                continue
+            if i == self.sep_id:
+                break
+            t = self.inv_vocab[i] if 0 <= i < len(self.inv_vocab) else "[UNK]"
+            if t.startswith("##") and toks:
+                toks[-1] += t[2:]
+            else:
+                toks.append(t)
+        return " ".join(toks)
+
+
+def build_tokenizer(tokenizer_path: str | None, for_t5: bool = False):
+    """Tokenizer factory honoring TOKENIZER_PATH with byte-level fallback."""
+    if tokenizer_path:
+        return WordPieceTokenizer(tokenizer_path)
+    return ByteTokenizer(add_cls_sep=not for_t5, add_eos=for_t5)

@@ -1,0 +1,3 @@
+from .device import DtypePolicy, apply_device_env, default_policy, get_devices
+
+__all__ = ["DtypePolicy", "apply_device_env", "default_policy", "get_devices"]

@@ -1,0 +1,93 @@
+"""L0 device runtime: platform selection, device discovery, dtype policy.
+
+Replaces the reference's ``torch.device`` / ``.to(device)`` layer
+(SURVEY.md §1 L0): here device placement is owned by XLA — params are
+materialized directly into device memory (HBM on TPU) with an explicit
+sharding, and the ``DEVICE`` env contract (BASELINE.json:5) maps onto
+``JAX_PLATFORMS``.
+
+``apply_device_env`` MUST run before the first ``import jax`` anywhere in
+the process; jax latches the platform at import time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def apply_device_env(device: str) -> None:
+    """Map DEVICE=tpu|cpu onto JAX_PLATFORMS before jax is imported.
+
+    tpu: leave platform selection to the environment (PJRT TPU plugin
+    auto-registers; a broken TPU init should raise, not silently fall
+    back to CPU). cpu: force the CPU backend.
+    """
+    import sys
+
+    if "jax" in sys.modules:
+        import jax
+
+        # jax already imported: verify rather than mutate.
+        plat = jax.default_backend()
+        if device == "cpu" and plat != "cpu":
+            raise RuntimeError(
+                f"DEVICE=cpu requested but jax already initialized on {plat!r}; "
+                "set JAX_PLATFORMS=cpu before starting the process"
+            )
+        return
+    if device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # XLA CPU's default conv/matmul precision is reduced; CPU serving
+        # is a correctness path, so buy back real f32 math. jax may have
+        # been pre-imported by the environment, so set the config directly.
+        import jax
+
+        jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def get_devices():
+    """All accelerator devices visible to this process, in stable order."""
+    import jax
+
+    return jax.devices()
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    """Mixed-precision policy tuned for the TPU MXU.
+
+    bf16 params + bf16 compute keeps matmuls/convs on the MXU fast path
+    and halves HBM traffic; logits/softmax come back in f32 so
+    postprocessing (argmax, label probabilities, sampling) is exact.
+    """
+
+    param_dtype: str = "bfloat16"
+    compute_dtype: str = "bfloat16"
+    output_dtype: str = "float32"
+
+    @property
+    def param_jnp(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def compute_jnp(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def output_jnp(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.output_dtype)
+
+
+def default_policy(device: str = "tpu") -> DtypePolicy:
+    """bf16 on TPU; f32 on CPU (CPU bf16 is slow and golden tests want
+    bit-comparable f32 math)."""
+    if device == "cpu":
+        return DtypePolicy("float32", "float32", "float32")
+    return DtypePolicy()

@@ -1,0 +1,3 @@
+from .config import ServiceConfig, load_config
+
+__all__ = ["ServiceConfig", "load_config"]

@@ -1,0 +1,146 @@
+"""Env-var driven service configuration (12-factor), typed via pydantic.
+
+Capability parity: the reference template configures itself entirely from
+environment variables read at startup — device selection (the north-star
+``DEVICE=tpu`` mode, BASELINE.json:5), model selection, ports, batching
+knobs (``max_batch=32``, BASELINE.json:10), and the parent orchestration
+server URL its registration client announces itself to (SURVEY.md §2).
+
+This module must stay import-light: no jax, no torch.  Device selection
+has to happen *before* jax is imported (see ``runtime.device``), so the
+config object is plain data.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pydantic import BaseModel, Field, field_validator
+
+_VALID_DEVICES = ("tpu", "cpu")
+
+
+class ServiceConfig(BaseModel):
+    """All knobs for one model-serving process."""
+
+    # Device runtime (L0). "tpu" routes through the PJRT TPU plugin,
+    # "cpu" forces JAX_PLATFORMS=cpu (useful for CI and local dev).
+    device: str = Field(default="tpu")
+    # Model zoo selection (L1).
+    model_name: str = Field(default="resnet50")
+    # Optional path to a converted checkpoint (orbax dir or .npz). When
+    # unset, models run from deterministic random init (no network, no
+    # HF hub in this environment — SURVEY.md §7.1).
+    model_path: str | None = None
+    # Optional tokenizer asset (vocab.txt for WordPiece / spm vocab). When
+    # unset, text models fall back to the built-in byte-level tokenizer.
+    tokenizer_path: str | None = None
+
+    # HTTP surface (L4).
+    host: str = "0.0.0.0"
+    port: int = 8000
+
+    # Dynamic batching (L3). max_batch mirrors the reference's knob
+    # (BASELINE.json:10); batch_timeout_ms is the max-wait policy.
+    max_batch: int = 32
+    batch_timeout_ms: float = 3.0
+    # Upper bound on queued requests before the server sheds load (503).
+    max_queue: int = 1024
+
+    # Static-shape buckets (L2). XLA compiles one executable per shape;
+    # requests are padded up to the nearest bucket (SURVEY.md §7.4.1).
+    batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32)
+    seq_buckets: tuple[int, ...] = (32, 64, 128, 256, 512)
+    max_seq_len: int = 512
+    # Warm (AOT-compile) every bucket at startup so compilation never
+    # lands on the request path. Disable for fast test startup.
+    warmup: bool = True
+
+    # Replica data-parallel serving (the NCCL-DataParallel equivalent).
+    # 0 = use every visible device.
+    replicas: int = 0
+
+    # Seq2seq decoding (T5).
+    max_decode_len: int = 64
+    stream_chunk_tokens: int = 4
+
+    # Parent orchestration-server registration (template parity:
+    # the public template self-registers with a Photo Analysis Server on
+    # startup, retrying until acked — SURVEY.md §1).
+    server_url: str | None = None
+    register_retry_s: float = 2.0
+    register_max_tries: int = 30
+
+    # Observability.
+    log_level: str = "INFO"
+
+    @field_validator("device")
+    @classmethod
+    def _check_device(cls, v: str) -> str:
+        v = v.lower()
+        if v not in _VALID_DEVICES:
+            raise ValueError(f"DEVICE must be one of {_VALID_DEVICES}, got {v!r}")
+        return v
+
+    @field_validator("max_batch")
+    @classmethod
+    def _check_max_batch(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError("MAX_BATCH must be >= 1")
+        return v
+
+
+def _env(name: str, default: str | None = None) -> str | None:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
+    """Build a ServiceConfig from environment variables.
+
+    Recognized variables (reference-parity names first):
+      DEVICE, MODEL_NAME, MODEL_PATH, TOKENIZER_PATH, HOST, PORT,
+      MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, MAX_SEQ_LEN,
+      MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL.
+    """
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+
+    def get(name: str, default: str | None = None) -> str | None:
+        v = e.get(name)
+        return v if v not in (None, "") else default
+
+    kwargs: dict = {}
+    mapping = {
+        "device": "DEVICE",
+        "model_name": "MODEL_NAME",
+        "model_path": "MODEL_PATH",
+        "tokenizer_path": "TOKENIZER_PATH",
+        "host": "HOST",
+        "server_url": "SERVER_URL",
+        "log_level": "LOG_LEVEL",
+    }
+    for field, var in mapping.items():
+        v = get(var)
+        if v is not None:
+            kwargs[field] = v
+    int_mapping = {
+        "port": "PORT",
+        "max_batch": "MAX_BATCH",
+        "max_queue": "MAX_QUEUE",
+        "replicas": "REPLICAS",
+        "max_seq_len": "MAX_SEQ_LEN",
+        "max_decode_len": "MAX_DECODE_LEN",
+    }
+    for field, var in int_mapping.items():
+        v = get(var)
+        if v is not None:
+            kwargs[field] = int(v)
+    v = get("BATCH_TIMEOUT_MS")
+    if v is not None:
+        kwargs["batch_timeout_ms"] = float(v)
+    v = get("WARMUP")
+    if v is not None:
+        kwargs["warmup"] = v.lower() not in ("0", "false", "no")
+    return ServiceConfig(**kwargs)

@@ -1,0 +1,33 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This is the JAX equivalent of a fake multi-accelerator backend
+(SURVEY.md §4): identical pmap/shard_map code paths, no TPU required.
+Must set env before the first jax import anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+# Serving defaults that keep tests fast.
+os.environ.setdefault("WARMUP", "0")
+
+# XLA CPU's default conv/matmul precision is reduced (bf16-ish passes);
+# golden tests need real f32 math. jax may already be imported by the
+# environment's sitecustomize, so set the config directly, not via env.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

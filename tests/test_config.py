@@ -1,0 +1,44 @@
+"""Config parsing from env vars (reference parity: env-driven 12-factor)."""
+
+import pytest
+
+from mlmicroservicetemplate_tpu.utils.config import load_config
+
+
+def test_defaults():
+    cfg = load_config(env={"DEVICE": "cpu"})
+    assert cfg.device == "cpu"
+    assert cfg.model_name == "resnet50"
+    assert cfg.max_batch == 32
+    assert cfg.port == 8000
+    assert cfg.batch_buckets[-1] == 32
+
+
+def test_env_overrides():
+    cfg = load_config(
+        env={
+            "DEVICE": "cpu",
+            "MODEL_NAME": "bert-base",
+            "MAX_BATCH": "16",
+            "PORT": "9001",
+            "BATCH_TIMEOUT_MS": "7.5",
+            "SERVER_URL": "http://parent:5000",
+            "WARMUP": "false",
+        }
+    )
+    assert cfg.model_name == "bert-base"
+    assert cfg.max_batch == 16
+    assert cfg.port == 9001
+    assert cfg.batch_timeout_ms == 7.5
+    assert cfg.server_url == "http://parent:5000"
+    assert cfg.warmup is False
+
+
+def test_bad_device_rejected():
+    with pytest.raises(Exception):
+        load_config(env={"DEVICE": "cuda"})
+
+
+def test_bad_max_batch_rejected():
+    with pytest.raises(Exception):
+        load_config(env={"DEVICE": "cpu", "MAX_BATCH": "0"})

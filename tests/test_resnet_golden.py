@@ -1,0 +1,62 @@
+"""Golden parity: JAX ResNet vs HF torch ResNet on shared random weights.
+
+This is the SURVEY.md §4 "engine" test: same weights, same input, CPU
+f32 both sides, outputs must agree to float tolerance. Catches layout
+bugs (OIHW→HWIO), stride placement (v1.5), BN stat handling.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import ResNetConfig as HFResNetConfig  # noqa: E402
+from transformers import ResNetForImageClassification  # noqa: E402
+
+import jax  # noqa: E402
+
+from mlmicroservicetemplate_tpu.convert import resnet_state_to_pytree  # noqa: E402
+from mlmicroservicetemplate_tpu.models import resnet  # noqa: E402
+
+
+def _randomize_bn_stats(model, seed=0):
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for name, buf in model.named_buffers():
+            if name.endswith("running_mean"):
+                buf.copy_(torch.randn(buf.shape, generator=g) * 0.1)
+            elif name.endswith("running_var"):
+                buf.copy_(torch.rand(buf.shape, generator=g) + 0.5)
+
+
+@pytest.mark.parametrize(
+    "depths,hidden,embed,img",
+    [
+        ((1, 1, 1, 1), (32, 64, 128, 256), 16, 64),
+        ((3, 4, 6, 3), (256, 512, 1024, 2048), 64, 224),  # real ResNet-50
+    ],
+    ids=["tiny", "resnet50"],
+)
+def test_resnet_matches_hf(depths, hidden, embed, img):
+    torch.manual_seed(0)
+    hf_cfg = HFResNetConfig(
+        embedding_size=embed,
+        hidden_sizes=list(hidden),
+        depths=list(depths),
+        num_labels=10,
+        layer_type="bottleneck",
+    )
+    hf = ResNetForImageClassification(hf_cfg).eval()
+    _randomize_bn_stats(hf)
+
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = resnet_state_to_pytree(state, depths=depths)
+    cfg = resnet.ResNetConfig(
+        embedding_size=embed, hidden_sizes=hidden, depths=depths, num_labels=10
+    )
+
+    x = np.random.RandomState(1).randn(2, img, img, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).logits.numpy()
+    got = np.asarray(jax.jit(lambda p, v: resnet.apply(p, cfg, v))(params, x))
+
+    np.testing.assert_allclose(got, ref, atol=2e-3, rtol=2e-3)

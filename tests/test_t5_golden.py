@@ -1,0 +1,110 @@
+"""Golden parity: JAX T5 vs HF torch T5 on shared random weights (CPU f32).
+
+Checks (a) encoder hidden states, (b) full greedy generation token
+sequences through the KV-cached scan decode — the strongest end-to-end
+check of the cache/relative-bias/tied-head plumbing.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+from transformers import T5Config as HFT5Config  # noqa: E402
+from transformers import T5ForConditionalGeneration  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mlmicroservicetemplate_tpu.convert import t5_state_to_pytree  # noqa: E402
+from mlmicroservicetemplate_tpu.models import t5  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_pair():
+    torch.manual_seed(0)
+    hf_cfg = HFT5Config(
+        vocab_size=512,
+        d_model=64,
+        d_kv=16,
+        num_heads=4,
+        num_layers=2,
+        d_ff=128,
+        decoder_start_token_id=0,
+    )
+    hf = T5ForConditionalGeneration(hf_cfg).eval()
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = t5_state_to_pytree(state, n_layers=2)
+    cfg = t5.T5Config(vocab_size=512, d_model=64, d_kv=16, num_heads=4, d_ff=128, num_layers=2)
+    return hf, params, cfg
+
+
+def _inputs(vocab, b=2, s=17, seed=3):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(10, vocab, (b, s)).astype(np.int32)
+    mask = np.ones((b, s), np.int32)
+    mask[1, 12:] = 0
+    ids[1, 12:] = 0
+    return ids, mask
+
+
+def test_t5_encoder_matches_hf(tiny_pair):
+    hf, params, cfg = tiny_pair
+    ids, mask = _inputs(cfg.vocab_size)
+    with torch.no_grad():
+        ref = hf.encoder(
+            input_ids=torch.from_numpy(ids).long(),
+            attention_mask=torch.from_numpy(mask).long(),
+        ).last_hidden_state.numpy()
+    got = np.asarray(jax.jit(lambda p, i, m: t5.encode(p, cfg, i, m))(params, ids, mask))
+    # Padded encoder positions are ignored downstream (cross-attn masks
+    # them); compare valid positions only.
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(got[valid], ref[valid], atol=3e-4, rtol=3e-3)
+
+
+def test_t5_greedy_generate_matches_hf(tiny_pair):
+    hf, params, cfg = tiny_pair
+    ids, mask = _inputs(cfg.vocab_size)
+    max_len = 12
+    with torch.no_grad():
+        ref = hf.generate(
+            input_ids=torch.from_numpy(ids).long(),
+            attention_mask=torch.from_numpy(mask).long(),
+            max_new_tokens=max_len,
+            min_new_tokens=max_len,  # HF pads after EOS; we compare raw steps below
+            do_sample=False,
+            num_beams=1,
+        ).numpy()
+    got = np.asarray(
+        jax.jit(lambda p, i, m: t5.greedy_generate(p, cfg, i, m, max_len))(params, ids, mask)
+    )
+    # HF output row: [decoder_start, t1, t2, ...]; ours: [t1, t2, ...].
+    # Compare until our EOS/pad-fill point per row.
+    for b in range(ids.shape[0]):
+        ours = got[b]
+        theirs = ref[b, 1 : 1 + max_len]
+        for t in range(max_len):
+            if ours[t] == cfg.pad_id and (t > 0 and ours[t - 1] in (cfg.eos_id, cfg.pad_id)):
+                break  # post-EOS pad fill
+            assert ours[t] == theirs[t], (b, t, ours, theirs)
+            if ours[t] == cfg.eos_id:
+                break
+
+
+def test_t5_chunked_equals_full(tiny_pair):
+    """Streaming chunks must produce the same tokens as one full scan."""
+    _, params, cfg = tiny_pair
+    ids, mask = _inputs(cfg.vocab_size, seed=5)
+    max_len = 12
+    full = np.asarray(
+        jax.jit(lambda p, i, m: t5.greedy_generate(p, cfg, i, m, max_len))(params, ids, mask)
+    )
+    enc = t5.encode(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    state = t5.init_decode_state(params, cfg, enc, jnp.asarray(mask), max_len)
+    chunks = []
+    step = jax.jit(lambda p, s: t5.generate_chunk(p, cfg, s, 4))
+    for _ in range(max_len // 4):
+        state, toks = step(params, state)
+        chunks.append(np.asarray(toks))
+    chunked = np.concatenate(chunks, axis=1)
+    np.testing.assert_array_equal(full, chunked)

@@ -1,0 +1,68 @@
+"""Tokenizer unit tests (pure functions, SURVEY.md §4 'Unit' row)."""
+
+import numpy as np
+
+from mlmicroservicetemplate_tpu.models.tokenizer import (
+    ByteTokenizer,
+    WordPieceTokenizer,
+    build_tokenizer,
+)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer(add_cls_sep=True)
+    ids, mask = tok.encode("Hello, TPU!", max_len=32)
+    assert ids.shape == (32,) and mask.shape == (32,)
+    assert ids[0] == tok.cls_id
+    n = int(mask.sum())
+    assert ids[n - 1] == tok.sep_id
+    assert (ids[n:] == tok.pad_id).all()
+    assert tok.decode(ids[1 : n - 1]) == "Hello, TPU!"
+
+
+def test_byte_truncation():
+    tok = ByteTokenizer(add_eos=True)
+    ids, mask = tok.encode("x" * 100, max_len=16)
+    assert int(mask.sum()) == 16
+    assert ids[15] == tok.eos_id
+
+
+def test_byte_unicode():
+    tok = ByteTokenizer()
+    s = "héllo ✓ 日本"
+    ids, mask = tok.encode(s, max_len=64)
+    assert tok.decode(ids[: int(mask.sum())]) == s
+
+
+def test_wordpiece(tmp_path):
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+             "fox", "jump", "##ed", "##s", "over", "lazy", "dog", "!"]
+    vp = tmp_path / "vocab.txt"
+    vp.write_text("\n".join(vocab))
+    tok = WordPieceTokenizer(str(vp))
+    ids, mask = tok.encode("The quick brown fox jumped!", max_len=16)
+    n = int(mask.sum())
+    toks = [tok.inv_vocab[i] for i in ids[:n]]
+    assert toks == ["[CLS]", "the", "quick", "brown", "fox", "jump", "##ed", "!", "[SEP]"]
+    assert tok.decode(ids[:n]) == "the quick brown fox jumped !"
+
+
+def test_wordpiece_unk(tmp_path):
+    vp = tmp_path / "vocab.txt"
+    vp.write_text("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello"]))
+    tok = WordPieceTokenizer(str(vp))
+    ids, mask = tok.encode("hello zzz", max_len=8)
+    n = int(mask.sum())
+    assert list(ids[:n]) == [tok.cls_id, tok.vocab["hello"], tok.unk_id, tok.sep_id]
+
+
+def test_factory_fallback():
+    bert_tok = build_tokenizer(None, for_t5=False)
+    t5_tok = build_tokenizer(None, for_t5=True)
+    ids, mask = bert_tok.encode("abc", 8)
+    assert ids[0] == bert_tok.cls_id
+    ids, mask = t5_tok.encode("abc", 8)
+    n = int(mask.sum())
+    assert ids[n - 1] == t5_tok.eos_id
+    # T5 byte fallback ids stay inside the t5-small vocab space.
+    assert ids.max() < 32128
