@@ -1,0 +1,26 @@
+# Containerized serving parity (SURVEY.md §2 "Packaging"): one model
+# per container, configured by env vars, DEVICE=tpu|cpu mode
+# (BASELINE.json:5).  The TPU image expects the host's libtpu/PJRT
+# plugin mounted or baked per fleet convention.
+FROM python:3.12-slim
+
+WORKDIR /app
+
+# jax[tpu] pin matches the verified build environment (SURVEY.md §7.1).
+COPY requirements.txt .
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY mlmicroservicetemplate_tpu/ mlmicroservicetemplate_tpu/
+
+ENV DEVICE=tpu \
+    MODEL_NAME=resnet50 \
+    HOST=0.0.0.0 \
+    PORT=8000 \
+    MAX_BATCH=32
+
+EXPOSE 8000
+
+HEALTHCHECK --interval=10s --timeout=3s --start-period=120s \
+    CMD python -c "import urllib.request,os;urllib.request.urlopen(f'http://localhost:{os.environ.get(\"PORT\",8000)}/readyz')"
+
+CMD ["python", "-m", "mlmicroservicetemplate_tpu.serve"]

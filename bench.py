@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 ``/predict`` through the full stack
+(HTTP → dynamic batcher → jitted engine on the chip).
+
+Prints ONE JSON line:
+  {"metric": "resnet50_predict_req_s_chip", "value": <req/s>,
+   "unit": "req/s", "vs_baseline": <ratio vs torch-CPU on this box>, ...}
+
+The judged metric is p50/p99 /predict latency + req/s/chip
+(BASELINE.json:2).  The reference publishes no numbers (SURVEY.md §6),
+so ``vs_baseline`` is measured against the reference's own inference
+stack (torch eval-mode ResNet-50) run on this box's CPU — the only
+reference path that exists in this environment.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import math
+import os
+import statistics
+import sys
+import time
+
+N_LATENCY = 40
+N_THROUGHPUT = 192
+CONCURRENCY = 64
+TORCH_ITERS = 3
+TORCH_BATCH = 8
+
+
+def _png_bytes(size: int = 224) -> bytes:
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    img = Image.fromarray(rng.integers(0, 255, (size, size, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+async def bench_serving() -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mlmicroservicetemplate_tpu.serve import build_service
+
+    overrides = {
+        "MODEL_NAME": "resnet50",
+        "WARMUP": "1",
+        # Only the buckets this bench exercises: batch-1 latency path +
+        # full dynamic batches under load.
+        "BATCH_BUCKETS": os.environ.get("BATCH_BUCKETS", "1,8,32"),
+        "LOG_LEVEL": "WARNING",
+    }
+    if os.environ.get("DEVICE"):
+        overrides["DEVICE"] = os.environ["DEVICE"]
+    cfg, bundle, engine, batcher, app = build_service(overrides)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        for _ in range(2400):  # warmup compiles all buckets before ready
+            resp = await client.get("/readyz")
+            if resp.status == 200:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("service never became ready")
+        png = _png_bytes()
+        headers = {"Content-Type": "image/png"}
+
+        # p50/p99: sequential single-image requests (config #1).
+        lats = []
+        for _ in range(N_LATENCY):
+            t0 = time.perf_counter()
+            resp = await client.post("/predict", data=png, headers=headers)
+            assert resp.status == 200, await resp.text()
+            await resp.json()
+            lats.append(time.perf_counter() - t0)
+
+        # req/s: concurrent load through the dynamic batcher (config #3).
+        sem = asyncio.Semaphore(CONCURRENCY)
+
+        async def one():
+            async with sem:
+                resp = await client.post("/predict", data=png, headers=headers)
+                assert resp.status == 200
+                await resp.read()
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one() for _ in range(N_THROUGHPUT)))
+        wall = time.perf_counter() - t0
+        import jax
+
+        return {
+            "p50_ms": round(statistics.median(lats) * 1000, 3),
+            "p99_ms": round(
+                sorted(lats)[max(0, math.ceil(len(lats) * 0.99) - 1)] * 1000, 3
+            ),
+            "req_s": round(N_THROUGHPUT / wall, 3),
+            "backend": jax.default_backend(),
+            "n_devices": engine.replicas.n_replicas,
+        }
+    finally:
+        await client.close()
+
+
+def bench_torch_cpu() -> float | None:
+    """The reference's inference path (torch eval ResNet-50) on this
+    box's CPU: images/s at the same batch size the batcher forms."""
+    if os.environ.get("SKIP_TORCH_BASELINE"):
+        return None
+    try:
+        import torch
+        from transformers import ResNetConfig, ResNetForImageClassification
+    except Exception as e:
+        print(f"torch baseline unavailable: {e}", file=sys.stderr)
+        return None
+    try:
+        with torch.no_grad():
+            model = ResNetForImageClassification(ResNetConfig()).eval()
+            x = torch.randn(TORCH_BATCH, 3, 224, 224)
+            model(x)  # warm
+            t0 = time.perf_counter()
+            for _ in range(TORCH_ITERS):
+                model(x)
+            wall = time.perf_counter() - t0
+        return TORCH_BATCH * TORCH_ITERS / wall
+    except Exception as e:
+        print(f"torch baseline failed: {e}", file=sys.stderr)
+        return None
+
+
+def main() -> None:
+    serving = asyncio.run(bench_serving())
+    torch_rps = bench_torch_cpu()
+    result = {
+        "metric": "resnet50_predict_req_s_chip",
+        "value": serving["req_s"],
+        "unit": "req/s",
+        "vs_baseline": (
+            round(serving["req_s"] / torch_rps, 3) if torch_rps else None
+        ),
+        **serving,
+        "torch_cpu_req_s": round(torch_rps, 3) if torch_rps else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""``python -m mlmicroservicetemplate_tpu`` → serve."""
+
+from .serve import main
+
+main()

@@ -1,0 +1,12 @@
+"""L4 HTTP surface (aiohttp).
+
+Endpoint parity with the reference's FastAPI app (SURVEY.md §2, §3.2):
+``POST /predict`` (JSON text or image upload → prediction JSON, chunked
+streaming for seq2seq), ``GET /status`` (template's introspection
+endpoint), plus the deliberate upgrades ``/healthz`` ``/readyz``
+``/metrics``.  FastAPI itself is not installable in this environment
+(SURVEY.md §7.1) — the capability contract is the endpoint behavior,
+not the dependency.
+"""
+
+from .app import build_app  # noqa: F401

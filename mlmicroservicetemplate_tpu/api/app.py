@@ -1,0 +1,258 @@
+"""aiohttp application: the ``/predict`` endpoint + observability.
+
+Request path parity with the reference (SURVEY.md §3.2): decode payload
+(JSON text | multipart or raw image bytes) → preprocess (thread
+offloaded — 1 vCPU, SURVEY.md §7.4.3) → dynamic-batching queue →
+engine dispatch → postprocess → JSON.  Seq2seq requests with
+``stream=true`` return an ``application/x-ndjson`` chunked body, one
+``{"delta": ...}`` line per decoded token chunk (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+import numpy as np
+from aiohttp import web
+
+from ..models.registry import KIND_SEQ2SEQ, ModelBundle, RawItem
+from ..scheduler import Batcher, QueueFullError
+from ..utils import metrics
+
+log = logging.getLogger(__name__)
+
+
+def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Application:
+    app = web.Application(client_max_size=32 * 1024 * 1024)
+    app["cfg"] = cfg
+    app["bundle"] = bundle
+    app["engine"] = engine
+    app["batcher"] = batcher
+    app["ready"] = asyncio.Event()
+    app["started_at"] = time.time()
+
+    app.router.add_post("/predict", handle_predict)
+    app.router.add_get("/healthz", handle_healthz)
+    app.router.add_get("/readyz", handle_readyz)
+    app.router.add_get("/status", handle_status)
+    app.router.add_get("/metrics", handle_metrics)
+
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    return app
+
+
+async def _on_startup(app: web.Application) -> None:
+    cfg, engine, batcher = app["cfg"], app["engine"], app["batcher"]
+    await batcher.start()
+
+    async def warm_then_ready():
+        if cfg.warmup:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, engine.warmup)
+        else:
+            # Canary dispatch: readiness means "the device answers",
+            # not just "the process is up".
+            await _canary(app)
+        app["ready"].set()
+        log.info("model %s ready", app["bundle"].name)
+
+    app["_ready_task"] = asyncio.get_running_loop().create_task(warm_then_ready())
+
+    if cfg.server_url:
+        from .registration import register_with_parent
+
+        app["_register_task"] = asyncio.get_running_loop().create_task(
+            register_with_parent(cfg, app["bundle"].name)
+        )
+
+
+async def _canary(app: web.Application) -> None:
+    bundle = app["bundle"]
+    if bundle.kind == "image_classification":
+        feats = {"image": np.zeros((bundle.image_size, bundle.image_size, 3), np.float32)}
+    else:
+        feats = {"input_ids": np.ones(8, np.int32), "length": np.int32(8)}
+    await app["batcher"].submit(feats)
+
+
+async def _on_cleanup(app: web.Application) -> None:
+    for key in ("_ready_task", "_register_task"):
+        task = app.get(key)
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+    await app["batcher"].stop()
+
+
+# ---------------------------------------------------------------------------
+# /predict
+
+
+async def _parse_request(request: web.Request) -> RawItem:
+    ctype = request.content_type
+    if ctype == "application/json":
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(reason="invalid JSON body")
+        if not isinstance(body, dict):
+            raise web.HTTPBadRequest(reason="JSON body must be an object")
+        text = body.get("text") or body.get("input")
+        if not isinstance(text, str) or not text:
+            raise web.HTTPBadRequest(reason='JSON body needs a non-empty "text" field')
+        stream = bool(body.get("stream", False))
+        return RawItem(text=text, stream=stream)
+    if ctype.startswith("multipart/"):
+        reader = await request.multipart()
+        async for part in reader:
+            if part.name in ("file", "image", "upload") or (
+                part.filename is not None
+            ):
+                data = await part.read(decode=False)
+                if data:
+                    return RawItem(image=bytes(data))
+            elif part.name == "text":
+                text = (await part.text()).strip()
+                if text:
+                    return RawItem(text=text)
+        raise web.HTTPBadRequest(reason="multipart body had no file/image/text part")
+    # Raw image bytes (image/* or octet-stream).
+    data = await request.read()
+    if not data:
+        raise web.HTTPBadRequest(reason="empty request body")
+    return RawItem(image=data)
+
+
+async def handle_predict(request: web.Request) -> web.StreamResponse:
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    t0 = time.monotonic()
+    item = await _parse_request(request)
+    stream = item.stream or request.query.get("stream", "") in ("1", "true")
+
+    loop = asyncio.get_running_loop()
+    try:
+        feats = await loop.run_in_executor(None, bundle.preprocess, item)
+    except (ValueError, OSError) as e:
+        # OSError covers PIL's UnidentifiedImageError on corrupt bytes.
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=str(e) or "undecodable payload")
+
+    if stream and bundle.kind == KIND_SEQ2SEQ:
+        return await _stream_predict(request, feats, t0)
+
+    try:
+        row = await app["batcher"].submit(feats)
+    except QueueFullError:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise web.HTTPServiceUnavailable(reason="batch queue full, retry later")
+    result = await loop.run_in_executor(None, bundle.postprocess, row)
+    dt = time.monotonic() - t0
+    result["model"] = bundle.name
+    result["timing_ms"] = round(dt * 1000.0, 3)
+    metrics.REQUESTS.labels(bundle.name, "200").inc()
+    metrics.LATENCY.labels(bundle.name).observe(dt)
+    return web.json_response(result)
+
+
+async def _stream_predict(
+    request: web.Request, feats: dict, t0: float
+) -> web.StreamResponse:
+    """Chunked seq2seq streaming: ndjson lines of decoded-token deltas."""
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    try:
+        stream_iter = app["batcher"].submit_stream(feats)
+    except QueueFullError:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise web.HTTPServiceUnavailable(reason="too many active streams, retry later")
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "application/x-ndjson", "X-Accel-Buffering": "no"},
+    )
+    resp.enable_chunked_encoding()
+    await resp.prepare(request)
+    eos = bundle.cfg.eos_id
+    pad = bundle.cfg.pad_id
+    tokens: list[int] = []
+    prev_text = ""
+    try:
+        async for chunk in stream_iter:
+            for t in chunk.tolist():
+                if t == eos:
+                    break
+                if t != pad or not tokens:
+                    tokens.append(int(t))
+            # Decode cumulatively so multi-token pieces render correctly,
+            # then emit only the new suffix.
+            text = bundle.tokenizer.decode(np.array(tokens, np.int32))
+            delta = text[len(prev_text):]
+            prev_text = text
+            if delta:
+                await resp.write(
+                    (json.dumps({"delta": delta}) + "\n").encode()
+                )
+        dt = time.monotonic() - t0
+        await resp.write(
+            (
+                json.dumps(
+                    {
+                        "done": True,
+                        "prediction": {"text": prev_text},
+                        "model": bundle.name,
+                        "timing_ms": round(dt * 1000.0, 3),
+                    }
+                )
+                + "\n"
+            ).encode()
+        )
+        metrics.REQUESTS.labels(bundle.name, "200").inc()
+        metrics.LATENCY.labels(bundle.name).observe(dt)
+    finally:
+        await resp.write_eof()
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# health / status / metrics
+
+
+async def handle_healthz(request: web.Request) -> web.Response:
+    return web.json_response({"alive": True})
+
+
+async def handle_readyz(request: web.Request) -> web.Response:
+    if request.app["ready"].is_set():
+        return web.json_response({"ready": True})
+    return web.json_response({"ready": False}, status=503)
+
+
+async def handle_status(request: web.Request) -> web.Response:
+    """Template-parity introspection endpoint (SURVEY.md §3.5)."""
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    import jax
+
+    return web.json_response(
+        {
+            "model": bundle.name,
+            "kind": bundle.kind,
+            "ready": app["ready"].is_set(),
+            "device": jax.default_backend(),
+            "n_devices": app["engine"].replicas.n_replicas,
+            "max_batch": app["cfg"].max_batch,
+            "uptime_s": round(time.time() - app["started_at"], 1),
+        }
+    )
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    body, ctype = metrics.render()
+    return web.Response(body=body, content_type=ctype.split(";")[0])

@@ -1,0 +1,40 @@
+"""Parent-server registration client (template parity, SURVEY.md §2).
+
+The public reference template self-registers with a companion
+orchestration server on startup: a retry-loop POST announcing the model
+name, host and port, repeated until the parent acks.  Same contract
+here, on aiohttp's client instead of ``requests``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import aiohttp
+
+log = logging.getLogger(__name__)
+
+
+async def register_with_parent(cfg, model_name: str) -> bool:
+    """POST {name, host, port} to ``cfg.server_url`` until acked (2xx)
+    or ``register_max_tries`` exhausted.  Returns True on ack."""
+    payload = {
+        "name": model_name,
+        "host": cfg.host if cfg.host not in ("0.0.0.0", "::") else "localhost",
+        "port": cfg.port,
+    }
+    url = cfg.server_url.rstrip("/") + "/register"
+    async with aiohttp.ClientSession() as session:
+        for attempt in range(1, cfg.register_max_tries + 1):
+            try:
+                async with session.post(url, json=payload, timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if 200 <= resp.status < 300:
+                        log.info("registered %s with %s (attempt %d)", model_name, url, attempt)
+                        return True
+                    log.warning("registration attempt %d: HTTP %d", attempt, resp.status)
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                log.warning("registration attempt %d failed: %s", attempt, e)
+            await asyncio.sleep(cfg.register_retry_s)
+    log.error("giving up registering with %s after %d tries", url, cfg.register_max_tries)
+    return False

@@ -1,0 +1,11 @@
+"""L2 inference engine: jit-compiled batch executables.
+
+The TPU-native replacement for the reference's
+``InferenceWorker.run_batch()`` (BASELINE.json:5): instead of an eager
+PyTorch forward per batch, each (batch-bucket × seq-bucket) shape gets
+one XLA executable, compiled ahead of the request path (SURVEY.md
+§7.4.1), and seq2seq generation is a single-dispatch ``lax.scan`` with a
+static KV cache instead of a per-token Python loop (§7.4.2).
+"""
+
+from .engine import InferenceEngine, bucket_for  # noqa: F401

@@ -17,13 +17,19 @@ IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
-def decode_image(data: bytes, image_size: int = 224) -> np.ndarray:
-    """JPEG/PNG bytes → normalized [H, W, 3] f32 (resize-shortest + center crop)."""
+def decode_image_u8(data: bytes, image_size: int = 224) -> np.ndarray:
+    """JPEG/PNG bytes → [H, W, 3] uint8 (resize-shortest + center crop).
+
+    Normalization deliberately does NOT happen here: uint8 crosses the
+    host→device boundary at 1/4 the bytes of f32, and the mean/std
+    affine runs on-device inside the jitted forward (fused into the
+    first conv by XLA).  On a relay-attached TPU the wire bytes are the
+    serving bottleneck, so this is a 4× cut on the dominant term.
+    """
     from PIL import Image
 
     img = Image.open(io.BytesIO(data)).convert("RGB")
     w, h = img.size
-    # Resize shortest side to size*256/224 (torchvision eval transform parity).
     short = int(round(image_size * 256 / 224))
     if w < h:
         nw, nh = short, max(1, int(round(h * short / w)))
@@ -33,7 +39,27 @@ def decode_image(data: bytes, image_size: int = 224) -> np.ndarray:
     left = (nw - image_size) // 2
     top = (nh - image_size) // 2
     img = img.crop((left, top, left + image_size, top + image_size))
-    x = np.asarray(img, np.float32) / 255.0
+    return np.asarray(img, np.uint8)
+
+
+def normalize_imagenet(x):
+    """Device-side ImageNet normalization: uint8 [.., 3] → f32.
+
+    Lives next to the host decode so the two halves of the reference's
+    preprocessing (SURVEY.md §2 ModelWrapper) stay in one place.
+    """
+    import jax.numpy as jnp
+
+    mean = jnp.asarray(IMAGENET_MEAN)
+    std = jnp.asarray(IMAGENET_STD)
+    return (x.astype(jnp.float32) / 255.0 - mean) / std
+
+
+def decode_image(data: bytes, image_size: int = 224) -> np.ndarray:
+    """JPEG/PNG bytes → normalized [H, W, 3] f32 (host-side normalize;
+    the serving path uses ``decode_image_u8`` + device-side
+    ``normalize_imagenet`` instead)."""
+    x = decode_image_u8(data, image_size).astype(np.float32) / 255.0
     return (x - IMAGENET_MEAN) / IMAGENET_STD
 
 

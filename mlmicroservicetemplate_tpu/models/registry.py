@@ -21,7 +21,7 @@ from ..runtime.device import DtypePolicy
 from . import bert as bert_mod
 from . import resnet as resnet_mod
 from . import t5 as t5_mod
-from .preprocess import decode_image, load_labels, softmax_np, topk_np
+from .preprocess import decode_image_u8, load_labels, normalize_imagenet, softmax_np, topk_np
 from .tokenizer import build_tokenizer
 
 log = logging.getLogger(__name__)
@@ -55,7 +55,8 @@ class ModelBundle:
         if self.kind == KIND_IMAGE:
             if item.image is None:
                 raise ValueError("this model expects an image payload")
-            return {"image": decode_image(item.image, self.image_size)}
+            # uint8 on the wire; normalization happens in-jit on device.
+            return {"image": decode_image_u8(item.image, self.image_size)}
         if item.text is None:
             raise ValueError("this model expects a text payload")
         max_len = self.cfg.max_position if hasattr(self.cfg, "max_position") else 512
@@ -127,7 +128,9 @@ def _build_resnet(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     params = cast_pytree(params, policy.param_jnp)
 
     def forward(p, images):
-        return resnet_mod.apply(p, cfg, images.astype(policy.compute_jnp))
+        # images arrive uint8; normalize on device, then cast for the MXU.
+        x = normalize_imagenet(images)
+        return resnet_mod.apply(p, cfg, x.astype(policy.compute_jnp))
 
     return ModelBundle(
         name="resnet50",

@@ -66,7 +66,9 @@ class ByteTokenizer:
             i = int(i)
             if i == self.eos_id:
                 break
-            if i >= self._byte_offset:
+            # Ids past the byte range (a model's vocab may exceed the
+            # tokenizer's) decode to nothing rather than crashing.
+            if self._byte_offset <= i < self._byte_offset + 256:
                 bs.append(i - self._byte_offset)
         return bs.decode("utf-8", errors="replace")
 

@@ -1,0 +1,13 @@
+"""Device-mesh data-parallel serving — the TPU-native answer to the
+reference's NCCL-broadcast ``torch.nn.DataParallel`` (BASELINE.json:5).
+
+Instead of a driver GPU broadcasting replicated weights and scattering
+sub-batches over NCCL, we build a ``jax.sharding.Mesh`` over the visible
+TPU cores, place params once with a fully-replicated ``NamedSharding``,
+and shard the batch axis across the ``replica`` mesh axis.  XLA compiles
+the scatter/gather into the executable as ICI collectives — there is no
+hand-written communication layer (SURVEY.md §5 "Distributed
+communication backend").
+"""
+
+from .mesh import ReplicaSet, make_mesh  # noqa: F401

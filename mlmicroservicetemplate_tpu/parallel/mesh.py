@@ -1,0 +1,79 @@
+"""Mesh construction + replica sharding for data-parallel serving.
+
+Capability parity: the reference serves multi-accelerator by wrapping
+the model in ``torch.nn.DataParallel`` — weights replicated per GPU via
+NCCL broadcast, inputs scattered, outputs gathered (SURVEY.md §3.4).
+Here the same contract is expressed as shardings on a 1-D device mesh:
+
+- params:  ``NamedSharding(mesh, P())``        — replicated on every core
+- batch:   ``NamedSharding(mesh, P("replica"))`` — leading axis split
+
+A jitted forward whose inputs carry these shardings compiles to one SPMD
+executable per shape bucket; XLA inserts the ICI collectives.  The
+degenerate 1-core mesh works identically (SURVEY.md §7.2 L0), so the
+single-chip and multi-chip serving paths are the same code.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def make_mesh(n_replicas: int = 0, devices=None):
+    """Build a 1-D ``('replica',)`` mesh over the first ``n_replicas``
+    visible devices (0 = all)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    if n_replicas:
+        if n_replicas > len(devs):
+            raise ValueError(
+                f"REPLICAS={n_replicas} but only {len(devs)} devices visible"
+            )
+        devs = devs[:n_replicas]
+    log.info("replica mesh over %d device(s): %s", len(devs), devs)
+    return Mesh(np.array(devs), ("replica",))
+
+
+class ReplicaSet:
+    """Owns the mesh and the two shardings of DP serving.
+
+    The engine asks it to (a) place params replicated, (b) place batch
+    arrays sharded on the leading axis, and (c) report the padding
+    multiple (batch sizes must divide evenly across replicas).
+    """
+
+    def __init__(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.param_sharding = NamedSharding(mesh, P())
+        self.batch_sharding = NamedSharding(mesh, P("replica"))
+
+    @property
+    def n_replicas(self) -> int:
+        return self.mesh.devices.size
+
+    def place_params(self, params):
+        """Replicate a param pytree onto every core (the NCCL-broadcast
+        equivalent; a single host→HBM transfer per core, done once)."""
+        import jax
+
+        return jax.device_put(params, self.param_sharding)
+
+    def place_batch(self, *arrays):
+        """Commit batch arrays with the leading axis sharded over
+        replicas.  jit then propagates these shardings through the
+        computation — no explicit in_shardings needed."""
+        import jax
+
+        placed = tuple(jax.device_put(a, self.batch_sharding) for a in arrays)
+        return placed if len(placed) != 1 else placed[0]
+
+    def pad_multiple(self) -> int:
+        return self.n_replicas

@@ -1,0 +1,118 @@
+"""Tensor-parallel sharding rules + a sharded train step (dp × tp).
+
+The reference needs only replica data-parallelism (SURVEY.md §2
+"Parallelism strategies"), but the framework's sharding layer is built
+the general TPU way: params carry ``NamedSharding``s over a
+``('dp', 'tp')`` mesh and XLA's sharding propagation inserts the ICI
+collectives (all-reduce after row-parallel matmuls, all-gather where
+layouts demand).  Megatron-style layout for the transformer blocks:
+
+- column-parallel (shard d_out over 'tp'):  attn q/k/v, mlp up
+- row-parallel   (shard d_in  over 'tp'):  attn out,   mlp down
+- embeddings: vocab axis over 'tp'; norms/biases-of-row-parallel
+  replicated.
+
+``train_step`` exists so multi-chip sharding is exercised end-to-end
+(forward + backward + optimizer update, donated state) even though the
+serving path itself is inference-only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_dp_tp_mesh(n_devices: int, tp: int | None = None, devices=None):
+    """2-D ``('dp','tp')`` mesh.  tp defaults to 2 when it divides the
+    device count (so both axes are real), else 1."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())[:n_devices]
+    if len(devs) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+    if tp is None:
+        tp = 2 if n_devices % 2 == 0 and n_devices > 1 else 1
+    dp = n_devices // tp
+    if dp * tp != n_devices:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    return Mesh(np.array(devs).reshape(dp, tp), ("dp", "tp"))
+
+
+def _bert_layer_spec():
+    from jax.sharding import PartitionSpec as P
+
+    col = {"kernel": P(None, "tp"), "bias": P("tp")}
+    row = {"kernel": P("tp", None), "bias": P()}
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "attn": {"q": col, "k": col, "v": col, "out": row, "ln": ln},
+        "mlp": {"up": col, "down": row, "ln": ln},
+    }
+
+
+def bert_param_spec(cfg):
+    """PartitionSpec pytree matching ``bert.init_params`` exactly."""
+    from jax.sharding import PartitionSpec as P
+
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "embeddings": {
+            "word": {"embedding": P("tp", None)},
+            "position": {"embedding": P()},
+            "token_type": {"embedding": P()},
+            "ln": ln,
+        },
+        "layers": [_bert_layer_spec() for _ in range(cfg.num_layers)],
+        "pooler": {"kernel": P(), "bias": P()},
+        "classifier": {"kernel": P(), "bias": P()},
+    }
+
+
+def shard_params(params, spec, mesh):
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, spec,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def make_train_step(cfg, mesh, learning_rate: float = 1e-4):
+    """Jitted full training step for the BERT classifier over the mesh:
+    data-parallel batch, tensor-parallel params, AdamW update, donated
+    (params, opt_state)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import bert as bert_mod
+
+    tx = optax.adamw(learning_rate)
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+    label_sharding = NamedSharding(mesh, P("dp"))
+
+    def loss_fn(params, ids, mask, labels):
+        logits = bert_mod.classify(params, cfg, ids, mask, dtype=jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        return nll.mean()
+
+    def train_step(params, opt_state, ids, mask, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, mask, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+
+    def init_and_place(key):
+        spec = bert_param_spec(cfg)
+        params = bert_mod.init_params(key, cfg=cfg)
+        params = shard_params(params, spec, mesh)
+        opt_state = tx.init(params)  # inherits param shardings leafwise
+        return params, opt_state
+
+    return jitted, init_and_place, (batch_sharding, label_sharding)

@@ -23,27 +23,26 @@ def apply_device_env(device: str) -> None:
     auto-registers; a broken TPU init should raise, not silently fall
     back to CPU). cpu: force the CPU backend.
     """
-    import sys
-
-    if "jax" in sys.modules:
-        import jax
-
-        # jax already imported: verify rather than mutate.
-        plat = jax.default_backend()
-        if device == "cpu" and plat != "cpu":
-            raise RuntimeError(
-                f"DEVICE=cpu requested but jax already initialized on {plat!r}; "
-                "set JAX_PLATFORMS=cpu before starting the process"
-            )
+    if device != "cpu":
         return
-    if device == "cpu":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        # XLA CPU's default conv/matmul precision is reduced; CPU serving
-        # is a correctness path, so buy back real f32 math. jax may have
-        # been pre-imported by the environment, so set the config directly.
-        import jax
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # jax is typically pre-imported by the environment's sitecustomize
+    # with JAX_PLATFORMS=tpu/axon, so the env var alone is too late —
+    # flip the config too.  The backend initializes lazily, so this
+    # works any time before the first device use; afterwards we can only
+    # verify.
+    import jax
 
-        jax.config.update("jax_default_matmul_precision", "highest")
+    jax.config.update("jax_platforms", "cpu")
+    # XLA CPU's default conv/matmul precision is reduced; CPU serving
+    # is a correctness path, so buy back real f32 math.
+    jax.config.update("jax_default_matmul_precision", "highest")
+    plat = jax.default_backend()
+    if plat != "cpu":
+        raise RuntimeError(
+            f"DEVICE=cpu requested but jax already initialized on {plat!r}; "
+            "set JAX_PLATFORMS=cpu before starting the process"
+        )
 
 
 def get_devices():

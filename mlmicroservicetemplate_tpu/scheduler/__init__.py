@@ -1,0 +1,10 @@
+"""L3 request scheduling: the dynamic-batching queue.
+
+The component the whole latency/throughput metric hinges on (SURVEY.md
+§3.2): concurrent ``/predict`` requests accumulate into batches under a
+max-batch-size (``max_batch=32``, BASELINE.json:10) + max-wait policy,
+one jitted dispatch serves the whole batch, and per-item results are
+routed back to each request's future.
+"""
+
+from .batcher import Batcher, QueueFullError  # noqa: F401

@@ -1,0 +1,212 @@
+"""Asyncio dynamic batcher: accumulate → dispatch → route futures.
+
+Policy (mirrors the reference's queue, SURVEY.md §2 "Dynamic-batching
+queue"): a batch closes when it reaches ``max_batch`` items or when
+``batch_timeout_ms`` has elapsed since its first item arrived —
+whichever comes first.  A burst that is already queued forms a full
+batch with zero added wait (the fast path drains without touching a
+timer).
+
+Device dispatch happens on a single worker thread
+(``run_in_executor``): JAX's blocking ``device_get`` must not stall the
+event loop, which on this 1-vCPU host also runs HTTP parsing and
+pre/post-processing (SURVEY.md §7.4.3).
+
+Backpressure: beyond ``max_queue`` waiting items, ``submit`` raises
+``QueueFullError`` which the API layer maps to 503 load-shed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, AsyncIterator
+
+import numpy as np
+
+from ..utils import metrics
+
+_END = object()
+
+
+class QueueFullError(Exception):
+    """Queue at capacity; shed load (HTTP 503)."""
+
+
+class Batcher:
+    def __init__(self, engine, cfg):
+        self.engine = engine
+        self.model = engine.bundle.name
+        self.max_batch = int(cfg.max_batch)
+        self.timeout_s = float(cfg.batch_timeout_ms) / 1000.0
+        self.max_queue = int(cfg.max_queue)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        # Dispatch threads = pipeline depth: batches overlap in flight
+        # so the host<->device round-trip of batch N hides behind the
+        # compute of batch N+1 (the engine's semaphore is the real cap).
+        depth = max(1, int(getattr(cfg, "pipeline_depth", 4)))
+        self._executor = ThreadPoolExecutor(
+            max_workers=depth, thread_name_prefix="dispatch"
+        )
+        # Streams hold a worker for their whole generation, so they get
+        # their own pool — a long-running stream must never starve the
+        # batch dispatch path.  Beyond max_streams concurrent streams we
+        # shed load rather than queue invisibly.
+        self.max_streams = int(getattr(cfg, "max_streams", 8))
+        self._stream_executor = ThreadPoolExecutor(
+            max_workers=self.max_streams, thread_name_prefix="stream"
+        )
+        self._active_streams = 0
+        self._task: asyncio.Task | None = None
+        self._inflight: set[asyncio.Task] = set()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._queue.put_nowait(_END)
+            await self._task
+            self._task = None
+        if self._inflight:
+            await asyncio.gather(*self._inflight, return_exceptions=True)
+        self._executor.shutdown(wait=False)
+        self._stream_executor.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    async def submit(self, feats: dict) -> np.ndarray:
+        """Enqueue one preprocessed item; resolves to its result row."""
+        if self._closed:
+            raise RuntimeError("batcher is stopped")
+        if self._queue.qsize() >= self.max_queue:
+            raise QueueFullError(f"queue depth {self._queue.qsize()} >= {self.max_queue}")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._queue.put_nowait((feats, fut, time.monotonic()))
+        metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
+        return await fut
+
+    def submit_stream(self, feats: dict) -> AsyncIterator[np.ndarray]:
+        """Streaming seq2seq: bridge the engine's blocking chunk
+        generator onto the event loop.  Each yielded array is one chunk
+        of token ids.
+
+        Admission is atomic: the counter check AND increment both happen
+        here, synchronously in the event loop, before the generator is
+        returned — so concurrent requests in the same loop window cannot
+        all slip under ``max_streams``, and the caller can still return
+        a 503 before any response bytes go out.  The decrement rides the
+        pump future's done-callback, so an abandoned (never-iterated or
+        half-consumed) generator cannot leak a slot."""
+        if self._closed:
+            raise RuntimeError("batcher is stopped")
+        if self._active_streams >= self.max_streams:
+            raise QueueFullError(
+                f"{self._active_streams} streams active >= max_streams={self.max_streams}"
+            )
+        loop = asyncio.get_running_loop()
+        chunks: asyncio.Queue = asyncio.Queue()
+        cancelled = threading.Event()
+
+        def pump():
+            try:
+                for chunk in self.engine.generate_stream(feats):
+                    loop.call_soon_threadsafe(chunks.put_nowait, chunk)
+                    metrics.TOKENS.labels(self.model).inc(int(chunk.size))
+                    if cancelled.is_set():
+                        return
+                loop.call_soon_threadsafe(chunks.put_nowait, _END)
+            except BaseException as e:  # propagate to the consumer
+                loop.call_soon_threadsafe(chunks.put_nowait, e)
+
+        self._active_streams += 1
+        pump_fut = loop.run_in_executor(self._stream_executor, pump)
+
+        def _release(_fut):
+            self._active_streams -= 1
+
+        pump_fut.add_done_callback(_release)
+
+        async def gen():
+            try:
+                while True:
+                    item = await chunks.get()
+                    if item is _END:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+            finally:
+                # Consumer gone (client disconnect / full drain): tell
+                # the pump to stop at the next chunk boundary.
+                cancelled.set()
+
+        return gen()
+
+    # ------------------------------------------------------------------
+    async def _run(self) -> None:
+        while True:
+            first = await self._queue.get()
+            if first is _END:
+                return
+            batch = [first]
+            deadline = time.monotonic() + self.timeout_s
+            while len(batch) < self.max_batch:
+                # Fast path: drain whatever is already queued.
+                try:
+                    item = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(self._queue.get(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+                if item is _END:
+                    self._spawn_dispatch(batch)
+                    return
+                batch.append(item)
+            metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
+            # Fire-and-track: the batcher immediately goes back to
+            # collecting while this batch's device round-trip is in
+            # flight (bounded by the engine's pipeline semaphore).
+            self._spawn_dispatch(batch)
+
+    def _spawn_dispatch(self, batch: list) -> None:
+        task = asyncio.get_running_loop().create_task(self._dispatch(batch))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _dispatch(self, batch: list) -> None:
+        loop = asyncio.get_running_loop()
+        now = time.monotonic()
+        feats = [b[0] for b in batch]
+        for _, _, t_in in batch:
+            metrics.QUEUE_WAIT.labels(self.model).observe(now - t_in)
+        metrics.BATCH_SIZE.labels(self.model).observe(len(batch))
+        t0 = time.monotonic()
+        try:
+            rows = await loop.run_in_executor(
+                self._executor, self.engine.run_batch, feats
+            )
+        except Exception as e:
+            for _, fut, _ in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        metrics.DEVICE_TIME.labels(self.model).observe(time.monotonic() - t0)
+        for (_, fut, _), row in zip(batch, rows):
+            if not fut.done():
+                fut.set_result(row)
+
+
+def batch_results(rows: list[np.ndarray]) -> Any:
+    """Helper for tests: stack row results."""
+    return np.stack(rows)

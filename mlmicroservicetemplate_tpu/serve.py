@@ -1,0 +1,81 @@
+"""Service entrypoint: config → device → model → engine → batcher → HTTP.
+
+The reference's ``main.py`` equivalent (SURVEY.md §3.1): boots the whole
+stack from env vars (12-factor) with optional CLI overrides, e.g.::
+
+    DEVICE=tpu MODEL_NAME=resnet50 python -m mlmicroservicetemplate_tpu.serve
+    python -m mlmicroservicetemplate_tpu.serve --model bert-base --device cpu --port 8080
+
+Import discipline: ``apply_device_env`` runs before any model/engine
+import so DEVICE=cpu can still steer the (possibly pre-imported) jax
+platform; torch never appears on this path (BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def parse_args(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser(description="TPU-native inference microservice")
+    p.add_argument("--model", dest="MODEL_NAME", help="resnet50 | bert-base | t5-small")
+    p.add_argument("--device", dest="DEVICE", help="tpu | cpu")
+    p.add_argument("--host", dest="HOST")
+    p.add_argument("--port", dest="PORT")
+    p.add_argument("--model-path", dest="MODEL_PATH")
+    p.add_argument("--tokenizer-path", dest="TOKENIZER_PATH")
+    p.add_argument("--max-batch", dest="MAX_BATCH")
+    p.add_argument("--batch-timeout-ms", dest="BATCH_TIMEOUT_MS")
+    p.add_argument("--replicas", dest="REPLICAS")
+    p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--server-url", dest="SERVER_URL")
+    args = p.parse_args(argv)
+    overrides = {k: str(v) for k, v in vars(args).items() if v is not None and k != "no_warmup"}
+    if args.no_warmup:
+        overrides["WARMUP"] = "0"
+    return overrides
+
+
+def build_service(overrides: dict | None = None):
+    """Assemble (cfg, bundle, engine, batcher, app) without running it."""
+    from .utils.config import load_config
+
+    cfg = load_config(overrides)
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    from .runtime.device import apply_device_env
+
+    apply_device_env(cfg.device)
+
+    from .api import build_app
+    from .engine import InferenceEngine
+    from .models.registry import build_model
+    from .scheduler import Batcher
+
+    bundle = build_model(cfg)
+    engine = InferenceEngine(bundle, cfg)
+    batcher = Batcher(engine, cfg)
+    app = build_app(cfg, bundle, engine, batcher)
+    return cfg, bundle, engine, batcher, app
+
+
+def main(argv: list[str] | None = None) -> None:
+    from aiohttp import web
+
+    overrides = parse_args(argv)
+    cfg, bundle, _, _, app = build_service(overrides)
+    log = logging.getLogger("serve")
+    log.info(
+        "serving %s on %s:%d (device=%s, max_batch=%d)",
+        bundle.name, cfg.host, cfg.port, cfg.device, cfg.max_batch,
+    )
+    web.run_app(app, host=cfg.host, port=cfg.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

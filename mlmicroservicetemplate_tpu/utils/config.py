@@ -46,6 +46,11 @@ class ServiceConfig(BaseModel):
     batch_timeout_ms: float = 3.0
     # Upper bound on queued requests before the server sheds load (503).
     max_queue: int = 1024
+    # Batches allowed in flight on the device concurrently. Dispatch and
+    # result-fetch round-trips overlap (XLA queues the work), so >1
+    # hides host<->device transfer latency behind compute. Especially
+    # important when the TPU sits behind a relay with high RTT.
+    pipeline_depth: int = 4
 
     # Static-shape buckets (L2). XLA compiles one executable per shape;
     # requests are padded up to the nearest bucket (SURVEY.md §7.4.1).
@@ -63,6 +68,9 @@ class ServiceConfig(BaseModel):
     # Seq2seq decoding (T5).
     max_decode_len: int = 64
     stream_chunk_tokens: int = 4
+    # Concurrent streaming generations admitted before 503 shedding
+    # (each stream holds a dedicated worker for its full generation).
+    max_streams: int = 8
 
     # Parent orchestration-server registration (template parity:
     # the public template self-registers with a Photo Analysis Server on
@@ -101,7 +109,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     Recognized variables (reference-parity names first):
       DEVICE, MODEL_NAME, MODEL_PATH, TOKENIZER_PATH, HOST, PORT,
       MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, MAX_SEQ_LEN,
-      MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL.
+      MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
+      MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS.
     """
     e = dict(os.environ)
     if env:
@@ -132,6 +141,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "replicas": "REPLICAS",
         "max_seq_len": "MAX_SEQ_LEN",
         "max_decode_len": "MAX_DECODE_LEN",
+        "pipeline_depth": "PIPELINE_DEPTH",
+        "max_streams": "MAX_STREAMS",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -140,6 +151,15 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("BATCH_TIMEOUT_MS")
     if v is not None:
         kwargs["batch_timeout_ms"] = float(v)
+    # Comma-separated bucket overrides, e.g. BATCH_BUCKETS=1,8,32 — used
+    # to bound warmup compile time when only some shapes will be served.
+    for field, var in (("batch_buckets", "BATCH_BUCKETS"), ("seq_buckets", "SEQ_BUCKETS")):
+        v = get(var)
+        if v is not None:
+            buckets = tuple(int(x) for x in v.split(",") if x.strip())
+            if not buckets:
+                raise ValueError(f"{var}={v!r} parsed to no buckets")
+            kwargs[field] = buckets
     v = get("WARMUP")
     if v is not None:
         kwargs["warmup"] = v.lower() not in ("0", "false", "no")

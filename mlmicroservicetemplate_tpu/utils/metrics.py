@@ -1,0 +1,79 @@
+"""Prometheus observability (SURVEY.md §5 "Metrics / logging").
+
+The reference template's only introspection is its ``/status`` endpoint
+and access logs; this module is the deliberate upgrade: request
+count/latency histograms, batch-size distribution (the lever behind
+req/s/chip), queue depth, and generated-token throughput, all exported
+at ``GET /metrics``.
+
+Kept import-safe without prometheus_client (stub fallback) so the core
+serving path never gains a hard dependency.
+"""
+
+from __future__ import annotations
+
+try:
+    from prometheus_client import (
+        CONTENT_TYPE_LATEST,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROM = True
+except Exception:  # pragma: no cover - prometheus_client is installed here
+    HAVE_PROM = False
+    CONTENT_TYPE_LATEST = "text/plain"
+
+    class _Noop:
+        def labels(self, *a, **k):
+            return self
+
+        def inc(self, *a, **k):
+            pass
+
+        def observe(self, *a, **k):
+            pass
+
+        def set(self, *a, **k):
+            pass
+
+    def Counter(*a, **k):  # noqa: N802
+        return _Noop()
+
+    Gauge = Histogram = Counter
+
+    def generate_latest():
+        return b"# prometheus_client not installed\n"
+
+
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+REQUESTS = Counter(
+    "predict_requests_total", "Completed /predict requests", ["model", "status"]
+)
+LATENCY = Histogram(
+    "predict_latency_seconds", "End-to-end /predict latency", ["model"],
+    buckets=_LATENCY_BUCKETS,
+)
+QUEUE_WAIT = Histogram(
+    "batch_queue_wait_seconds", "Time a request waits in the batching queue",
+    ["model"], buckets=_LATENCY_BUCKETS,
+)
+DEVICE_TIME = Histogram(
+    "device_batch_seconds", "Device time per dispatched batch", ["model"],
+    buckets=_LATENCY_BUCKETS,
+)
+BATCH_SIZE = Histogram(
+    "batch_size", "Items per dispatched batch", ["model"],
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+QUEUE_DEPTH = Gauge("batch_queue_depth", "Requests currently queued", ["model"])
+TOKENS = Counter("generated_tokens_total", "Seq2seq tokens generated", ["model"])
+
+
+def render() -> tuple[bytes, str]:
+    return generate_latest(), CONTENT_TYPE_LATEST

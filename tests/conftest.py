@@ -19,6 +19,11 @@ os.environ.setdefault("WARMUP", "0")
 # environment's sitecustomize, so set the config directly, not via env.
 import jax  # noqa: E402
 
+# sitecustomize pre-imports jax with JAX_PLATFORMS=axon (TPU relay), so
+# the env assignment above came too late for jax's import-time config
+# latch. The backend itself initializes lazily, so flipping the config
+# here — before any jax.devices() call — still lands on CPU.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402

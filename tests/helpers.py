@@ -1,0 +1,135 @@
+"""Tiny-config ModelBundle builders for fast engine/scheduler/API tests.
+
+Mirrors the registry builders but with small architectures so CPU tests
+stay quick; the golden tests cover full-size fidelity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from mlmicroservicetemplate_tpu.models import bert as bert_mod
+from mlmicroservicetemplate_tpu.models import resnet as resnet_mod
+from mlmicroservicetemplate_tpu.models import t5 as t5_mod
+from mlmicroservicetemplate_tpu.models.registry import (
+    KIND_IMAGE,
+    KIND_SEQ2SEQ,
+    KIND_TEXT,
+    ModelBundle,
+)
+from mlmicroservicetemplate_tpu.models.tokenizer import build_tokenizer
+from mlmicroservicetemplate_tpu.runtime.device import default_policy
+
+TINY_RESNET = functools.partial(
+    resnet_mod.ResNetConfig,
+    embedding_size=8,
+    hidden_sizes=(8, 16, 16, 32),
+    depths=(1, 1, 1, 1),
+    num_labels=10,
+    image_size=32,
+)
+TINY_BERT = functools.partial(
+    bert_mod.BertConfig,
+    vocab_size=512,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=2,
+    intermediate_size=64,
+    max_position=128,
+    num_labels=3,
+)
+TINY_T5 = functools.partial(
+    t5_mod.T5Config,
+    vocab_size=384,
+    d_model=32,
+    d_kv=8,
+    num_heads=2,
+    d_ff=64,
+    num_layers=2,
+)
+
+
+def tiny_resnet_bundle(seed: int = 0) -> ModelBundle:
+    import jax
+
+    cfg = TINY_RESNET()
+    policy = default_policy("cpu")
+    params = resnet_mod.init_params(jax.random.PRNGKey(seed), cfg=cfg)
+
+    def forward(p, images):
+        from mlmicroservicetemplate_tpu.models.preprocess import normalize_imagenet
+
+        x = normalize_imagenet(images)
+        return resnet_mod.apply(p, cfg, x.astype(policy.compute_jnp))
+
+    return ModelBundle(
+        name="resnet50", kind=KIND_IMAGE, cfg=cfg, params=params, policy=policy,
+        tokenizer=None, labels=None, forward=forward, image_size=cfg.image_size,
+    )
+
+
+def tiny_bert_bundle(seed: int = 0) -> ModelBundle:
+    import jax
+
+    cfg = TINY_BERT()
+    policy = default_policy("cpu")
+    params = bert_mod.init_params(jax.random.PRNGKey(seed), cfg=cfg)
+
+    def forward(p, input_ids, attention_mask):
+        return bert_mod.classify(
+            p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp
+        )
+
+    return ModelBundle(
+        name="bert-base", kind=KIND_TEXT, cfg=cfg, params=params, policy=policy,
+        tokenizer=build_tokenizer(None, for_t5=False), labels=["a", "b", "c"],
+        forward=forward,
+    )
+
+
+def tiny_t5_bundle(seed: int = 0) -> ModelBundle:
+    import jax
+
+    cfg = TINY_T5()
+    policy = default_policy("cpu")
+    params = t5_mod.init_params(jax.random.PRNGKey(seed), cfg=cfg)
+    # Untie the LM head with fresh random weights: tied heads + random
+    # init argmax-lock onto the start token (self-correlation of the
+    # residual stream), which would make generation tests trivially
+    # all-pad.  A random untied head yields diverse token sequences.
+    import jax.numpy as jnp
+
+    params["lm_head"] = {
+        "kernel": jax.random.normal(
+            jax.random.PRNGKey(seed + 99), (cfg.d_model, cfg.vocab_size), jnp.float32
+        )
+    }
+
+    def encode_fn(p, input_ids, attention_mask):
+        return t5_mod.encode(p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp)
+
+    def init_state_fn(p, enc_out, enc_mask, max_len: int):
+        return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len)
+
+    def generate_chunk_fn(p, state, n_steps: int):
+        return t5_mod.generate_chunk(p, cfg, state, n_steps)
+
+    return ModelBundle(
+        name="t5-small", kind=KIND_SEQ2SEQ, cfg=cfg, params=params, policy=policy,
+        tokenizer=build_tokenizer(None, for_t5=True), labels=None, forward=None,
+        encode_fn=encode_fn, init_state_fn=init_state_fn,
+        generate_chunk_fn=generate_chunk_fn,
+    )
+
+
+def rand_image(seed: int = 0, size: int = 32) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+
+
+def text_feats(tokenizer, text: str, max_len: int = 128) -> dict:
+    ids, mask = tokenizer.encode(text, max_len)
+    n = int(mask.sum())
+    return {"input_ids": ids[:n], "length": np.int32(n)}

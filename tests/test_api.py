@@ -1,0 +1,206 @@
+"""HTTP integration tests (SURVEY.md §4): in-process aiohttp server,
+real payloads, JSON schema + streaming chunk assertions."""
+
+import asyncio
+import io
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from helpers import tiny_bert_bundle, tiny_resnet_bundle, tiny_t5_bundle
+from mlmicroservicetemplate_tpu.api import build_app
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.scheduler import Batcher
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2, 4, 8))
+    kw.setdefault("seq_buckets", (16, 32, 64))
+    kw.setdefault("max_decode_len", 8)
+    kw.setdefault("stream_chunk_tokens", 4)
+    kw.setdefault("batch_timeout_ms", 1.0)
+    return ServiceConfig(**kw)
+
+
+def _png_bytes(size: int = 32) -> bytes:
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    img = Image.fromarray(rng.integers(0, 255, (size, size, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _run(bundle_fn, body, **cfg_kw):
+    async def main():
+        cfg = _cfg(**cfg_kw)
+        bundle = bundle_fn()
+        engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(engine, cfg)
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # Wait for the canary/warmup readiness flip.
+            for _ in range(200):
+                resp = await client.get("/readyz")
+                if resp.status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            return await body(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(main())
+
+
+def test_image_predict_raw_and_multipart():
+    async def body(client):
+        png = _png_bytes()
+        # Raw bytes
+        resp = await client.post(
+            "/predict", data=png, headers={"Content-Type": "image/png"}
+        )
+        assert resp.status == 200
+        out = await resp.json()
+        assert out["model"] == "resnet50"
+        assert "class_id" in out["prediction"]
+        assert len(out["topk"]) == 5
+        # Multipart upload (the template's upload style)
+        from aiohttp import FormData
+
+        form = FormData()
+        form.add_field("file", png, filename="x.png", content_type="image/png")
+        resp2 = await client.post("/predict", data=form)
+        assert resp2.status == 200
+        out2 = await resp2.json()
+        assert out2["prediction"]["class_id"] == out["prediction"]["class_id"]
+        # Corrupt image bytes must 400, not 500 (PIL raises OSError).
+        resp3 = await client.post(
+            "/predict", data=b"not an image", headers={"Content-Type": "image/png"}
+        )
+        assert resp3.status == 400
+
+    _run(tiny_resnet_bundle, body)
+
+
+def test_text_predict_and_errors():
+    async def body(client):
+        resp = await client.post("/predict", json={"text": "hello world"})
+        assert resp.status == 200
+        out = await resp.json()
+        assert out["prediction"]["label"] in ("a", "b", "c")
+        assert abs(sum(out["probs"]) - 1.0) < 1e-3
+        # Missing text -> 400
+        resp = await client.post("/predict", json={"foo": 1})
+        assert resp.status == 400
+        # Image payload to a text model -> 400
+        resp = await client.post(
+            "/predict", data=b"\x89PNG not really", headers={"Content-Type": "image/png"}
+        )
+        assert resp.status == 400
+
+    _run(tiny_bert_bundle, body)
+
+
+def test_seq2seq_nonstream_and_stream():
+    async def body(client):
+        resp = await client.post("/predict", json={"text": "summarize: hello"})
+        assert resp.status == 200
+        out = await resp.json()
+        assert "text" in out["prediction"]
+
+        resp = await client.post(
+            "/predict", json={"text": "summarize: hello", "stream": True}
+        )
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("application/x-ndjson")
+        lines = [json.loads(l) for l in (await resp.text()).strip().splitlines()]
+        assert lines, "no ndjson lines"
+        assert lines[-1].get("done") is True
+        deltas = "".join(l.get("delta", "") for l in lines[:-1])
+        assert lines[-1]["prediction"]["text"] == deltas
+
+    _run(tiny_t5_bundle, body)
+
+
+def test_stream_shedding_503():
+    """Beyond max_streams concurrent generations, stream requests shed
+    with 503 before any response bytes go out."""
+
+    async def body(client):
+        payload = {"text": "summarize: busy", "stream": True}
+        tasks = [
+            asyncio.create_task(client.post("/predict", json=payload))
+            for _ in range(4)
+        ]
+        resps = await asyncio.gather(*tasks)
+        statuses = sorted(r.status for r in resps)
+        for r in resps:
+            await r.read()
+        assert 503 in statuses, statuses
+        assert 200 in statuses, statuses
+
+    _run(tiny_t5_bundle, body, max_streams=1, max_decode_len=32)
+
+
+def test_health_status_metrics():
+    async def body(client):
+        assert (await client.get("/healthz")).status == 200
+        resp = await client.get("/status")
+        st = await resp.json()
+        assert st["model"] == "bert-base"
+        assert st["ready"] is True
+        assert st["device"] == "cpu"
+        # issue one request so metrics have content
+        await client.post("/predict", json={"text": "hi"})
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert "predict_requests_total" in text
+        assert "batch_size" in text
+
+    _run(tiny_bert_bundle, body)
+
+
+def test_registration_client():
+    """Parent-server registration: retry-POST until acked."""
+
+    from aiohttp import web
+
+    from mlmicroservicetemplate_tpu.api.registration import register_with_parent
+
+    async def main():
+        seen = []
+        fails = {"n": 2}  # fail the first 2 attempts to exercise the retry loop
+
+        async def register(request):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                return web.Response(status=500)
+            seen.append(await request.json())
+            return web.json_response({"ok": True})
+
+        parent = web.Application()
+        parent.router.add_post("/register", register)
+        server = TestServer(parent)
+        await server.start_server()
+        try:
+            cfg = _cfg(
+                server_url=f"http://localhost:{server.port}",
+                register_retry_s=0.01,
+                register_max_tries=10,
+            )
+            ok = await register_with_parent(cfg, "bert-base")
+            assert ok
+            assert seen and seen[0]["name"] == "bert-base"
+        finally:
+            await server.close()
+
+    asyncio.run(main())

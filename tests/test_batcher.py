@@ -1,0 +1,127 @@
+"""Scheduler property tests (SURVEY.md §4): batch cap, wait-timeout,
+exact result routing under concurrency, load shedding, streaming bridge.
+
+Uses a fake engine — the batcher's contract is independent of JAX."""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.scheduler import Batcher, QueueFullError
+
+
+class FakeEngine:
+    def __init__(self, delay: float = 0.0):
+        self.bundle = SimpleNamespace(name="fake")
+        self.delay = delay
+        self.batches: list[int] = []
+
+    def run_batch(self, feats):
+        self.batches.append(len(feats))
+        if self.delay:
+            time.sleep(self.delay)
+        # Row encodes (item id, batch size it rode in) for routing checks.
+        return [np.array([f["id"], len(feats)]) for f in feats]
+
+    def generate_stream(self, feats):
+        for i in range(3):
+            yield np.array([feats["id"] * 10 + i])
+
+
+def _cfg(**kw):
+    base = dict(max_batch=8, batch_timeout_ms=5.0, max_queue=1024)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+async def _with_batcher(cfg, engine, body):
+    b = Batcher(engine, cfg)
+    await b.start()
+    try:
+        return await body(b)
+    finally:
+        await b.stop()
+
+
+def test_routing_exact_under_concurrency():
+    """100 concurrent submits: every caller gets exactly its own row."""
+    eng = FakeEngine()
+
+    async def body(b):
+        rows = await asyncio.gather(*(b.submit({"id": i}) for i in range(100)))
+        for i, row in enumerate(rows):
+            assert row[0] == i
+        return rows
+
+    asyncio.run(_with_batcher(_cfg(), eng, body))
+    assert max(eng.batches) <= 8
+    assert sum(eng.batches) == 100
+
+
+def test_full_batch_closes_without_timer():
+    """A queued burst ≥ max_batch dispatches a full batch immediately."""
+    eng = FakeEngine()
+
+    async def body(b):
+        await asyncio.gather(*(b.submit({"id": i}) for i in range(16)))
+
+    asyncio.run(_with_batcher(_cfg(max_batch=16, batch_timeout_ms=10_000), eng, body))
+    assert eng.batches[0] == 16
+
+
+def test_timeout_closes_partial_batch():
+    """A lone request must not wait longer than ~batch_timeout_ms."""
+    eng = FakeEngine()
+
+    async def body(b):
+        t0 = time.monotonic()
+        await b.submit({"id": 0})
+        return time.monotonic() - t0
+
+    dt = asyncio.run(_with_batcher(_cfg(max_batch=32, batch_timeout_ms=20), eng, body))
+    assert dt < 1.0
+    assert eng.batches == [1]
+
+
+def test_load_shedding():
+    """Past max_queue waiting items, submit raises QueueFullError."""
+    eng = FakeEngine(delay=0.05)
+
+    async def body(b):
+        results = await asyncio.gather(
+            *(b.submit({"id": i}) for i in range(64)), return_exceptions=True
+        )
+        shed = [r for r in results if isinstance(r, QueueFullError)]
+        ok = [r for r in results if isinstance(r, np.ndarray)]
+        assert shed, "expected some requests shed"
+        assert ok, "expected some requests served"
+        # Every served request still got its own row.
+        served_ids = sorted(int(r[0]) for r in ok)
+        assert len(set(served_ids)) == len(served_ids)
+
+    asyncio.run(_with_batcher(_cfg(max_batch=2, max_queue=4), eng, body))
+
+
+def test_engine_error_propagates():
+    class Boom(FakeEngine):
+        def run_batch(self, feats):
+            raise RuntimeError("device on fire")
+
+    async def body(b):
+        with pytest.raises(RuntimeError, match="device on fire"):
+            await b.submit({"id": 1})
+
+    asyncio.run(_with_batcher(_cfg(), Boom(), body))
+
+
+def test_stream_bridge():
+    eng = FakeEngine()
+
+    async def body(b):
+        chunks = [c async for c in b.submit_stream({"id": 7})]
+        assert [int(c[0]) for c in chunks] == [70, 71, 72]
+
+    asyncio.run(_with_batcher(_cfg(), eng, body))

@@ -1,0 +1,119 @@
+"""Engine tests: bucket padding is invisible, chunked decode == full
+decode, replica-sharded serving == single-device serving."""
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine, bucket_for
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import (
+    rand_image,
+    text_feats,
+    tiny_bert_bundle,
+    tiny_resnet_bundle,
+    tiny_t5_bundle,
+)
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2, 4, 8))
+    kw.setdefault("seq_buckets", (16, 32, 64))
+    kw.setdefault("max_decode_len", 12)
+    kw.setdefault("stream_chunk_tokens", 4)
+    return ServiceConfig(**kw)
+
+
+def test_bucket_for():
+    assert bucket_for(1, (1, 2, 4)) == 1
+    assert bucket_for(3, (1, 2, 4)) == 4
+    assert bucket_for(5, (1, 2, 4)) == 5  # past max: rounded up to multiple
+    assert bucket_for(1, (1, 2, 4), multiple=2) == 2
+    assert bucket_for(3, (1, 2, 4), multiple=4) == 4
+
+
+def test_image_padding_invisible():
+    """A 3-item batch padded to bucket 4 must return exactly the
+    unpadded single-item results."""
+    import jax
+
+    bundle = tiny_resnet_bundle()
+    eng = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    imgs = [rand_image(i) for i in range(3)]
+    rows = eng.run_batch([{"image": im} for im in imgs])
+    assert len(rows) == 3
+    direct = jax.device_get(
+        jax.jit(bundle.forward)(bundle.params, np.stack(imgs))
+    )
+    np.testing.assert_allclose(np.stack(rows), direct, rtol=2e-5, atol=2e-5)
+
+
+def test_text_seq_bucketing():
+    """Variable-length texts pad to one seq bucket; the mask hides the
+    pads so results equal per-item unpadded forwards."""
+    import jax
+
+    bundle = tiny_bert_bundle()
+    eng = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    texts = ["short", "a somewhat longer sentence for bucketing", "mid size text"]
+    feats = [text_feats(bundle.tokenizer, t) for t in texts]
+    rows = eng.run_batch(feats)
+    for f, row in zip(feats, rows):
+        L = int(f["length"])
+        ids = f["input_ids"][None, :L]
+        mask = np.ones((1, L), np.int32)
+        direct = jax.device_get(bundle.forward(bundle.params, ids, mask))[0]
+        np.testing.assert_allclose(row, direct, rtol=2e-4, atol=2e-4)
+
+
+def test_oversize_batch_splits():
+    bundle = tiny_resnet_bundle()
+    eng = InferenceEngine(bundle, _cfg(batch_buckets=(1, 2)), ReplicaSet(make_mesh(1)))
+    rows = eng.run_batch([{"image": rand_image(i)} for i in range(5)])
+    assert len(rows) == 5
+
+
+def test_t5_stream_matches_full():
+    """Chunked streaming decode must produce the same tokens as the
+    one-dispatch full generate (same scan, different chunking)."""
+    bundle = tiny_t5_bundle()
+    eng = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    feats = text_feats(bundle.tokenizer, "summarize: the quick brown fox")
+    full = eng.run_batch([feats])[0]
+    streamed = np.concatenate(list(eng.generate_stream(dict(feats))))
+    n = min(len(streamed), len(full))
+    np.testing.assert_array_equal(streamed[:n], full[:n])
+
+
+@pytest.mark.parametrize("bundle_fn", [tiny_bert_bundle, tiny_resnet_bundle])
+def test_replicated_matches_single(bundle_fn, cpu_devices):
+    """8-replica mesh serving (batch sharded over 'replica') returns the
+    same results as the degenerate 1-core mesh — the DataParallel
+    contract (SURVEY.md §3.4)."""
+    bundle = bundle_fn()
+    cfg = _cfg(batch_buckets=(8,))
+    eng1 = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    eng8 = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(8)))
+    assert eng8.replicas.n_replicas == 8
+    if bundle.kind == "image_classification":
+        feats = [{"image": rand_image(i)} for i in range(5)]
+    else:
+        feats = [
+            text_feats(bundle.tokenizer, f"sample text number {i} with padding")
+            for i in range(5)
+        ]
+    r1 = eng1.run_batch([dict(f) for f in feats])
+    r8 = eng8.run_batch([dict(f) for f in feats])
+    np.testing.assert_allclose(np.stack(r1), np.stack(r8), rtol=2e-4, atol=2e-4)
+
+
+def test_warmup_compiles_buckets():
+    bundle = tiny_bert_bundle()
+    eng = InferenceEngine(
+        bundle, _cfg(batch_buckets=(1, 2), seq_buckets=(16,)), ReplicaSet(make_mesh(1))
+    )
+    dt = eng.warmup()
+    assert dt >= 0.0

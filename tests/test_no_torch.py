@@ -1,0 +1,32 @@
+"""Import discipline: no torch on the serving import path
+(BASELINE.json:5 — "no `torch.cuda` on the import path"; SURVEY.md
+§7.4.6).  torch may appear only inside the offline checkpoint-conversion
+tool, so importing the serving stack in a fresh interpreter must not
+pull it in."""
+
+import subprocess
+import sys
+
+CHECK = """
+import sys
+import mlmicroservicetemplate_tpu
+import mlmicroservicetemplate_tpu.api
+import mlmicroservicetemplate_tpu.engine
+import mlmicroservicetemplate_tpu.scheduler
+import mlmicroservicetemplate_tpu.serve
+import mlmicroservicetemplate_tpu.parallel
+assert "torch" not in sys.modules, "torch leaked onto the serving import path"
+print("OK")
+"""
+
+
+def test_no_torch_on_import_path():
+    out = subprocess.run(
+        [sys.executable, "-c", CHECK],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
