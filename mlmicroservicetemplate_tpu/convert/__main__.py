@@ -1,0 +1,56 @@
+"""Offline checkpoint-conversion CLI: HF/torch weights → servable pytree.
+
+The operator-facing half of the reference's ``ModelWrapper.load()``
+contract (BASELINE.json:5): run once offline, point the service at the
+output with ``MODEL_PATH``, and the server materializes params straight
+into device memory with no torch anywhere on its import path.
+
+    python -m mlmicroservicetemplate_tpu.convert \
+        --model bert-base --input pytorch_model.bin --output /ckpt/bert
+
+Input formats: .safetensors / .npz (no torch needed), .bin/.pt/.pth
+(torch, CPU only).  Output: an orbax checkpoint directory, which
+``load_pytree`` restores directly (warm starts skip conversion).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+CONVERTERS = {
+    "resnet50": "resnet_state_to_pytree",
+    "bert-base": "bert_state_to_pytree",
+    "t5-small": "t5_state_to_pytree",
+}
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", required=True, choices=sorted(CONVERTERS))
+    p.add_argument("--input", required=True, help="state-dict file (.safetensors/.npz/.bin/.pt)")
+    p.add_argument("--output", required=True, help="orbax checkpoint directory")
+    p.add_argument(
+        "--num-layers", type=int, default=None,
+        help="override transformer layer count (default: the model's standard depth)",
+    )
+    args = p.parse_args(argv)
+
+    from ..models.checkpoint import load_state_dict, save_pytree
+    from . import hf_maps
+
+    convert = getattr(hf_maps, CONVERTERS[args.model])
+    state = load_state_dict(args.input)
+    kwargs = {}
+    if args.num_layers is not None:
+        if args.model == "resnet50":
+            p.error("--num-layers applies to transformer models, not resnet50")
+        kwargs["n_layers"] = args.num_layers
+    pytree = convert(state, **kwargs)
+
+    save_pytree(args.output, pytree)
+    print(f"converted {args.input} -> {args.output}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

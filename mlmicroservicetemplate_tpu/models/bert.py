@@ -78,12 +78,25 @@ def init_params(key, cfg: BertConfig = BertConfig()) -> Params:
     return params
 
 
-def _layer(p: Params, cfg: BertConfig, x: jax.Array, mask: jax.Array) -> jax.Array:
+def _layer(
+    p: Params,
+    cfg: BertConfig,
+    x: jax.Array,
+    mask: jax.Array,
+    key_mask: jax.Array | None = None,
+) -> jax.Array:
     a = p["attn"]
     q = split_heads(dense(a["q"], x), cfg.num_heads)
     k = split_heads(dense(a["k"], x), cfg.num_heads)
     v = split_heads(dense(a["v"], x), cfg.num_heads)
-    ctx = merge_heads(mha_attention(q, k, v, mask=mask))
+    if key_mask is not None:
+        # Pallas fused path: scores/softmax stay VMEM-resident
+        # (opt-in via USE_PALLAS_ATTENTION, see ops/attention.py).
+        from ..ops.attention import fused_attention
+
+        ctx = merge_heads(fused_attention(q, k, v, key_mask))
+    else:
+        ctx = merge_heads(mha_attention(q, k, v, mask=mask))
     x = layernorm(a["ln"], x + dense(a["out"], ctx), eps=cfg.ln_eps)
     m = p["mlp"]
     h = dense(m["down"], gelu(dense(m["up"], x)))
@@ -97,6 +110,7 @@ def encode(
     attention_mask: jax.Array,  # [B, S] 1=keep
     token_type_ids: jax.Array | None = None,
     dtype=jnp.float32,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Returns the final hidden states [B, S, D]."""
     b, s = input_ids.shape
@@ -107,8 +121,12 @@ def encode(
     x = x + embed(e["token_type"], tt, dtype)
     x = layernorm(e["ln"], x, eps=cfg.ln_eps)
     mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S]
+    # use_pallas must be decided by the CALLER (the serving wrapper):
+    # the kernel has no VJP and no sharding awareness, so training and
+    # tp-sharded consumers of encode() stay on the jnp path.
+    key_mask = attention_mask if use_pallas else None
     for layer in params["layers"]:
-        x = _layer(layer, cfg, x, mask)
+        x = _layer(layer, cfg, x, mask, key_mask=key_mask)
     return x
 
 
@@ -119,8 +137,11 @@ def classify(
     attention_mask: jax.Array,
     token_type_ids: jax.Array | None = None,
     dtype=jnp.float32,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Sequence classification logits [B, num_labels] in f32 (the serving path)."""
-    hidden = encode(params, cfg, input_ids, attention_mask, token_type_ids, dtype)
+    hidden = encode(
+        params, cfg, input_ids, attention_mask, token_type_ids, dtype, use_pallas
+    )
     pooled = jnp.tanh(dense(params["pooler"], hidden[:, 0]).astype(jnp.float32))
     return dense(params["classifier"], pooled.astype(jnp.float32))

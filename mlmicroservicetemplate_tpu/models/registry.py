@@ -155,9 +155,17 @@ def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                            bert_state_to_pytree)
     params = cast_pytree(params, policy.param_jnp)
 
+    # Decide the Pallas fused-attention path once, at serving-build
+    # time: inference-only call site, so the kernel's lack of VJP and
+    # sharding rules never leaks into training/tp consumers.
+    from ..ops.attention import use_pallas_attention
+
+    use_pallas = use_pallas_attention()
+
     def forward(p, input_ids, attention_mask):
         return bert_mod.classify(
-            p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp
+            p, cfg, input_ids, attention_mask,
+            dtype=policy.compute_jnp, use_pallas=use_pallas,
         )
 
     return ModelBundle(

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+The serving models' FLOPs live in attention + matmuls; XLA fuses most
+elementwise work already, so kernels here target what XLA does NOT do
+well: keeping the [S, S] attention score matrix VMEM-resident instead
+of round-tripping it through HBM (``attention.fused_attention``).
+
+Kernels are opt-in per call site and always have a pure-jnp reference
+implementation next to them — CPU/CI runs use the reference (or
+``interpret=True``), TPU serving can flip them on via
+``USE_PALLAS_ATTENTION=1``.
+"""
+
+from .attention import fused_attention, use_pallas_attention  # noqa: F401

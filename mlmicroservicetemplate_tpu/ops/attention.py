@@ -1,0 +1,91 @@
+"""Fused multi-head attention as a Pallas TPU kernel.
+
+One grid program per (batch, head): Q/K/V tiles stream HBM→VMEM once,
+the [S, S] score matrix, mask, softmax, and the probs·V matmul all stay
+in VMEM, and only the [S, D] context tile goes back to HBM.  The
+un-fused XLA path materializes the f32 score tensor in HBM twice
+(write after QK^T, read for softmax·V) — at S=512, H=12 that is
+2·B·12·512·512·4B of HBM traffic this kernel never pays.
+
+Encoder sizes here (S ≤ 512, D = 64) fit whole heads in VMEM
+(512·512·4B scores + 3·512·64 tiles ≈ 1.3 MB of ~16 MB), so no online
+softmax is needed; this is the single-block regime, not FlashAttention.
+
+Serving-shape contract: no bias support (BERT/ResNet path; the T5
+encoder needs rel-pos bias and keeps the jnp path), optional padding
+mask, Sq == Sk.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def use_pallas_attention() -> bool:
+    """Opt-in: USE_PALLAS_ATTENTION=1 and a TPU backend present."""
+    if os.environ.get("USE_PALLAS_ATTENTION", "").lower() not in ("1", "true", "yes"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float):
+    # Block shapes: q/k/v [1, 1, S, D]; mask [1, 1, S]; o [1, 1, S, D].
+    q = q_ref[0, 0].astype(jnp.float32)  # [S, D]
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0]
+    scores = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [S, S]
+    mask = mask_ref[0]  # [1, S] int32, 1 = keep (key-side padding mask)
+    scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    ctx = jax.lax.dot_general(
+        probs, v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0] = ctx.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def fused_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, H, D]
+    v: jax.Array,  # [B, S, H, D]
+    mask: jax.Array,  # [B, S] 1 = keep
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for ``common.mha_attention(q, k, v, mask=broadcast)`` on
+    the encoder self-attention shapes; returns [B, S, H, D]."""
+    from jax.experimental import pallas as pl
+
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # [B, S, H, D] -> [B, H, S, D]: per-(b,h) tiles are contiguous for
+    # the grid; XLA fuses the transposes into neighbors.
+    qt, kt, vt = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
+    bhsd = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+    # TPU tiling wants the mask block's trailing dims to equal the array
+    # dims, so carry it as [B, 1, S] with a (1, 1, S) block.
+    mask3 = mask.astype(jnp.int32)[:, None, :]
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale),
+        grid=(b, h),
+        in_specs=[bhsd, bhsd, bhsd, pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))],
+        out_specs=bhsd,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, mask3)
+    return jnp.transpose(out, (0, 2, 1, 3))

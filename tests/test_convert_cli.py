@@ -1,0 +1,43 @@
+"""Offline conversion CLI round-trip: HF state dict → orbax → pytree
+identical to direct conversion (SURVEY.md §5 checkpoint plan)."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+
+from mlmicroservicetemplate_tpu.convert import bert_state_to_pytree  # noqa: E402
+from mlmicroservicetemplate_tpu.convert.__main__ import main as convert_main  # noqa: E402
+from mlmicroservicetemplate_tpu.models.checkpoint import load_pytree  # noqa: E402
+
+
+def test_cli_roundtrip(tmp_path):
+    from safetensors.numpy import save_file
+    from transformers import BertConfig as HFBertConfig
+    from transformers import BertForSequenceClassification
+
+    hf = BertForSequenceClassification(
+        HFBertConfig(
+            vocab_size=200, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64, num_labels=3,
+        )
+    ).eval()
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    src = tmp_path / "model.safetensors"
+    save_file(state, str(src))
+    out = tmp_path / "ckpt"
+
+    convert_main([
+        "--model", "bert-base", "--input", str(src),
+        "--output", str(out), "--num-layers", "2",
+    ])
+
+    direct = bert_state_to_pytree(state, n_layers=2)
+    restored = load_pytree(str(out), converter=None)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        direct,
+        restored,
+    )

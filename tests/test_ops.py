@@ -1,0 +1,33 @@
+"""Pallas kernel parity: fused attention (interpret mode on CPU) must
+match the jnp reference including padding-mask handling."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mlmicroservicetemplate_tpu.models.common import mha_attention
+from mlmicroservicetemplate_tpu.ops.attention import fused_attention
+
+
+@pytest.mark.parametrize("s,d,h", [(32, 16, 2), (128, 64, 4)])
+def test_fused_attention_matches_reference(s, d, h):
+    b = 3
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32) for _ in range(3)
+    )
+    mask = np.ones((b, s), np.int32)
+    mask[1, s // 2 :] = 0  # one padded row exercises masking
+    mask = jnp.asarray(mask)
+
+    ref = mha_attention(q, k, v, mask=mask[:, None, None, :].astype(bool))
+    got = fused_attention(q, k, v, mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_bert_pallas_flag_off_by_default():
+    from mlmicroservicetemplate_tpu.ops.attention import use_pallas_attention
+
+    assert use_pallas_attention() is False  # CPU test env, env var unset
