@@ -1,0 +1,129 @@
+"""Shared serving-benchmark machinery: spin the real service in-process
+(HTTP → batcher → engine → chip) and measure what the judge measures
+(SURVEY.md §6): p50/p99 latency, req/s/chip, TTFT, tokens/s."""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import math
+import statistics
+import time
+
+
+def png_bytes(size: int = 224, seed: int = 0) -> bytes:
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    img = Image.fromarray(rng.integers(0, 255, (size, size, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def pctile(xs: list[float], q: float) -> float:
+    return sorted(xs)[max(0, math.ceil(len(xs) * q) - 1)]
+
+
+class ServiceUnderTest:
+    """Async context manager: a fully-started in-process service."""
+
+    def __init__(self, overrides: dict):
+        self.overrides = {"LOG_LEVEL": "WARNING", **overrides}
+        self.client = None
+        self.engine = None
+
+    async def __aenter__(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from mlmicroservicetemplate_tpu.serve import build_service
+
+        cfg, bundle, engine, batcher, app = build_service(self.overrides)
+        self.engine = engine
+        self.client = TestClient(TestServer(app))
+        await self.client.start_server()
+        for _ in range(2400):
+            resp = await self.client.get("/readyz")
+            if resp.status == 200:
+                return self
+            await asyncio.sleep(0.25)
+        raise RuntimeError("service never became ready")
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+
+    # ------------------------------------------------------------------
+    async def latency(self, make_request, n: int = 40) -> dict:
+        """Sequential single-request latencies (the p50 config)."""
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            resp = await make_request(self.client)
+            assert resp.status == 200, await resp.text()
+            await resp.read()
+            lats.append(time.perf_counter() - t0)
+        return {
+            "p50_ms": round(statistics.median(lats) * 1000, 2),
+            "p99_ms": round(pctile(lats, 0.99) * 1000, 2),
+        }
+
+    async def throughput(
+        self, make_request, n: int = 192, concurrency: int = 64
+    ) -> dict:
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one():
+            async with sem:
+                resp = await make_request(self.client)
+                assert resp.status == 200
+                await resp.read()
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one() for _ in range(n)))
+        wall = time.perf_counter() - t0
+        return {"req_s": round(n / wall, 2)}
+
+    async def stream_stats(self, text: str, n: int = 8) -> dict:
+        """TTFT + tokens/s through the chunked ndjson stream."""
+        ttfts, tok_rates = [], []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            resp = await self.client.post(
+                "/predict", json={"text": text, "stream": True}
+            )
+            assert resp.status == 200
+            first, tokens = None, 0
+            async for line in resp.content:
+                if first is None:
+                    first = time.perf_counter() - t0
+                msg = json.loads(line)
+                if msg.get("done"):
+                    # decode_steps measures device decode throughput even
+                    # when random-init weights produce no visible text.
+                    tokens = int(msg.get("decode_steps", 0))
+                    break
+            wall = time.perf_counter() - t0
+            ttfts.append(first if first is not None else wall)
+            tok_rates.append(tokens / wall if wall else 0.0)
+        return {
+            "ttft_p50_ms": round(statistics.median(ttfts) * 1000, 2),
+            "decode_steps_s": round(statistics.median(tok_rates), 2),
+        }
+
+
+def post_image(png: bytes):
+    def make(client):
+        return client.post(
+            "/predict", data=png, headers={"Content-Type": "image/png"}
+        )
+
+    return make
+
+
+def post_text(text: str):
+    def make(client):
+        return client.post("/predict", json={"text": text})
+
+    return make

@@ -1,0 +1,78 @@
+"""Run the full BASELINE.md §6 benchmark table (all five configs).
+
+    python benchmarks/run_all.py              # current backend (tpu)
+    DEVICE=cpu python benchmarks/run_all.py   # CPU sanity run
+
+Writes one JSON line per config to stdout and a markdown table to
+stderr.  ``bench.py`` at the repo root stays the driver-facing headline
+(config 3); this harness is the complete judged surface:
+
+  1. ResNet-50 single-image /predict       -> p50/p99
+  2. BERT-base text /predict, batch=1      -> p50/p99
+  3. ResNet-50 dynamic batching, max_batch -> req/s/chip
+  4. BERT-base replica serving             -> req/s over all devices
+  5. T5-small streaming seq2seq            -> TTFT, chunks/s
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))  # repo root, for the package
+from harness import ServiceUnderTest, png_bytes, post_image, post_text  # noqa: E402
+
+
+async def main() -> None:
+    rows = []
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    png = png_bytes()
+
+    async with ServiceUnderTest(
+        {"MODEL_NAME": "resnet50", "BATCH_BUCKETS": "1,8,32", **dev}
+    ) as s:
+        r1 = await s.latency(post_image(png))
+        rows.append({"config": "resnet50 single-image latency", **r1})
+        r3 = await s.throughput(post_image(png))
+        rows.append({"config": "resnet50 dynamic batching max_batch=32", **r3})
+
+    async with ServiceUnderTest(
+        {"MODEL_NAME": "bert-base", "BATCH_BUCKETS": "1,8,32", "SEQ_BUCKETS": "32,128", **dev}
+    ) as s:
+        r2 = await s.latency(post_text("a short benchmark sentence"))
+        rows.append({"config": "bert-base batch=1 latency", **r2})
+        n_dev = s.engine.replicas.n_replicas
+        r4 = await s.throughput(post_text("a short benchmark sentence"))
+        rows.append(
+            {"config": f"bert-base replica serving ({n_dev} device)", **r4}
+        )
+
+    async with ServiceUnderTest(
+        {
+            "MODEL_NAME": "t5-small",
+            "BATCH_BUCKETS": "1,8",
+            "SEQ_BUCKETS": "32,64",
+            "MAX_DECODE_LEN": "32",
+            **dev,
+        }
+    ) as s:
+        r5 = await s.stream_stats("summarize: the quick brown fox jumps over the lazy dog")
+        rows.append({"config": "t5-small streaming seq2seq", **r5})
+
+    import jax
+
+    backend = jax.default_backend()
+    print(f"\n| config | metrics | backend |", file=sys.stderr)
+    print("|---|---|---|", file=sys.stderr)
+    for row in rows:
+        metrics = ", ".join(f"{k}={v}" for k, v in row.items() if k != "config")
+        print(f"| {row['config']} | {metrics} | {backend} |", file=sys.stderr)
+        print(json.dumps({**row, "backend": backend}))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 
 import numpy as np
@@ -39,6 +40,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/status", handle_status)
     app.router.add_get("/metrics", handle_metrics)
+    app.router.add_post("/debug/trace", handle_trace)
 
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
@@ -183,8 +185,10 @@ async def _stream_predict(
     pad = bundle.cfg.pad_id
     tokens: list[int] = []
     prev_text = ""
+    decode_steps = 0
     try:
         async for chunk in stream_iter:
+            decode_steps += int(chunk.size)
             for t in chunk.tolist():
                 if t == eos:
                     break
@@ -195,10 +199,9 @@ async def _stream_predict(
             text = bundle.tokenizer.decode(np.array(tokens, np.int32))
             delta = text[len(prev_text):]
             prev_text = text
-            if delta:
-                await resp.write(
-                    (json.dumps({"delta": delta}) + "\n").encode()
-                )
+            # One line per device chunk even when the decoded delta is
+            # empty: clients get progress/TTFT signal at chunk cadence.
+            await resp.write((json.dumps({"delta": delta}) + "\n").encode())
         dt = time.monotonic() - t0
         await resp.write(
             (
@@ -206,6 +209,8 @@ async def _stream_predict(
                     {
                         "done": True,
                         "prediction": {"text": prev_text},
+                        "tokens_generated": len(tokens),
+                        "decode_steps": decode_steps,
                         "model": bundle.name,
                         "timing_ms": round(dt * 1000.0, 3),
                     }
@@ -256,3 +261,44 @@ async def handle_status(request: web.Request) -> web.Response:
 async def handle_metrics(request: web.Request) -> web.Response:
     body, ctype = metrics.render()
     return web.Response(body=body, content_type=ctype.split(";")[0])
+
+
+async def handle_trace(request: web.Request) -> web.Response:
+    """On-demand device profiling (SURVEY.md §5 tracing plan): capture a
+    jax.profiler trace for N seconds while traffic flows, write a
+    perfetto-compatible dump, return its path.
+
+    POST /debug/trace {"seconds": 2}  (dump dir: JAX_TRACE_DIR env)
+    """
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    try:
+        seconds = float(body.get("seconds", 2.0))
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(reason='"seconds" must be a number')
+    if not (0.0 < seconds <= 30.0):  # also rejects NaN
+        raise web.HTTPBadRequest(reason='"seconds" must be in (0, 30]')
+    # The dump location is server-owned (JAX_TRACE_DIR env), never
+    # client-controlled — this endpoint must not become an
+    # arbitrary-path file-write primitive.
+    trace_dir = os.environ.get("JAX_TRACE_DIR", "/tmp/jax-trace")
+    if request.app.get("_tracing"):
+        raise web.HTTPConflict(reason="a trace is already running")
+    request.app["_tracing"] = True
+    import jax
+
+    try:
+        jax.profiler.start_trace(trace_dir)
+        await asyncio.sleep(seconds)
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("stop_trace failed: %s", e)
+        request.app["_tracing"] = False
+    return web.json_response(
+        {"trace_dir": trace_dir, "seconds": seconds,
+         "hint": "open in perfetto or tensorboard --logdir"}
+    )

@@ -71,9 +71,18 @@ class InferenceEngine:
         )
 
         if bundle.kind == KIND_SEQ2SEQ:
-            self._encode = jax.jit(bundle.encode_fn)
-            self._init_state = jax.jit(bundle.init_state_fn, static_argnums=3)
             self._gen_chunk = jax.jit(bundle.generate_chunk_fn, static_argnums=2)
+
+            # encode + cache init + first decode chunk fused into ONE
+            # executable: time-to-first-token pays a single device
+            # round-trip instead of three (encode / init / chunk each
+            # cost a full relay RTT otherwise).
+            def start(p, ids, mask, max_len: int, n_steps: int):
+                enc = bundle.encode_fn(p, ids, mask)
+                state = bundle.init_state_fn(p, enc, mask, max_len)
+                return bundle.generate_chunk_fn(p, state, n_steps)
+
+            self._start = jax.jit(start, static_argnums=(3, 4))
         else:
             self._forward = jax.jit(bundle.forward)
 
@@ -134,12 +143,13 @@ class InferenceEngine:
                 ids, mask, n = self._collate_text(feats)
                 ids, mask = self.replicas.place_batch(ids, mask)
                 logits = self._forward(self.params, ids, mask)
-            else:  # seq2seq, non-streaming: one scan over the full budget
+            else:  # seq2seq, non-streaming: ONE dispatch for the whole
+                # encode + init + full decode scan
                 ids, mask, n = self._collate_text(feats)
                 ids, mask = self.replicas.place_batch(ids, mask)
-                enc = self._encode(self.params, ids, mask)
-                state = self._init_state(self.params, enc, mask, self.max_decode_len)
-                state, _ = self._gen_chunk(self.params, state, self.max_decode_len)
+                state, _ = self._start(
+                    self.params, ids, mask, self.max_decode_len, self.max_decode_len
+                )
                 logits = state.tokens
             rows = np.asarray(jax.device_get(logits))
         return [rows[i] for i in range(n)]
@@ -154,14 +164,23 @@ class InferenceEngine:
         with self._lock:
             ids, mask, _ = self._collate_text([feats])
             ids, mask = self.replicas.place_batch(ids, mask)
-            enc = self._encode(self.params, ids, mask)
-            state = self._init_state(self.params, enc, mask, self.max_decode_len)
-        produced = 0
+            # First chunk fused with encode+init: TTFT = one round-trip.
+            state, toks = self._start(
+                self.params, ids, mask, self.max_decode_len, self.chunk_tokens
+            )
+            # One transfer for tokens+done — each device_get pays a full
+            # relay round-trip, so never fetch them separately.
+            toks_np, done_np = jax.device_get((toks, state.done))
+            chunk, done = toks_np[0], bool(done_np[0])
+        produced = self.chunk_tokens
+        yield chunk
+        if done:
+            return
         while produced < self.max_decode_len:
             with self._lock:
                 state, toks = self._gen_chunk(self.params, state, self.chunk_tokens)
-                chunk = np.asarray(jax.device_get(toks))[0]
-                done = bool(jax.device_get(state.done)[0])
+                toks_np, done_np = jax.device_get((toks, state.done))
+                chunk, done = toks_np[0], bool(done_np[0])
             produced += self.chunk_tokens
             yield chunk
             if done:
@@ -173,6 +192,8 @@ class InferenceEngine:
     def warmup(self) -> float:
         """Compile all (batch × seq) buckets + decode scans.  Returns
         seconds spent; call at startup, before readiness flips true."""
+        import jax
+
         t0 = time.monotonic()
         mult = self._pad_multiple()
         batch_buckets = [b for b in self.batch_buckets if b % mult == 0 and b >= mult]
@@ -198,14 +219,23 @@ class InferenceEngine:
                         {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
                     ] * b
                     self.run_batch(feats)
-            # The streaming chunk executable compiles per encoder seq
-            # bucket (the KV-cache/cross-attn shapes depend on it), so
-            # warm one chunk at EVERY seq bucket, not just the smallest.
+            # The streaming start + follow-up chunk executables compile
+            # per encoder seq bucket (KV-cache/cross-attn shapes depend
+            # on it).  Warm both DIRECTLY — going through
+            # generate_stream would skip the follow-up chunk whenever
+            # the dummy prompt hits EOS inside the first chunk.
             for s in self.seq_buckets:
-                for _ in self.generate_stream(
-                    {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
-                ):
-                    break
+                feats = {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
+                with self._lock:
+                    ids, mask, _ = self._collate_text([feats])
+                    ids, mask = self.replicas.place_batch(ids, mask)
+                    state, _ = self._start(
+                        self.params, ids, mask, self.max_decode_len, self.chunk_tokens
+                    )
+                    state, toks = self._gen_chunk(
+                        self.params, state, self.chunk_tokens
+                    )
+                    jax.device_get(toks)
         dt = time.monotonic() - t0
         log.info("warmup compiled %s buckets in %.1fs", self.bundle.name, dt)
         return dt
