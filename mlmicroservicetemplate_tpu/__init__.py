@@ -31,3 +31,10 @@ Import discipline: importing this package must never pull in torch
 """
 
 __version__ = "0.1.0"
+
+
+def register_model(name, builder):
+    """Template extension point — see models.registry.register_model."""
+    from .models.registry import register_model as _rm
+
+    _rm(name, builder)

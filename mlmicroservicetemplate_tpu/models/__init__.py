@@ -1,2 +1,8 @@
 from . import bert, resnet, t5  # noqa: F401
-from .registry import MODEL_REGISTRY, ModelBundle, build_model  # noqa: F401
+from .registry import (  # noqa: F401
+    MODEL_REGISTRY,
+    ModelBundle,
+    RawItem,
+    build_model,
+    register_model,
+)

@@ -225,6 +225,25 @@ MODEL_REGISTRY["bert-base-uncased"] = _build_bert
 MODEL_REGISTRY["t5small"] = _build_t5
 
 
+def register_model(name: str, builder: Callable) -> None:
+    """The template's extension point: plug YOUR model into the stack.
+
+    The reference repo is a *template* — its README tells users to
+    implement their model behind ``ModelWrapper`` hooks and get the
+    HTTP service, batching and deployment for free (SURVEY.md §1–2).
+    Same contract here: register ``builder(svc_cfg, policy) ->
+    ModelBundle`` under a name, set ``MODEL_NAME=<name>``, and the
+    engine/scheduler/API serve it with bucketed jit, dynamic batching
+    and replica sharding unchanged.  See
+    ``docs/custom_models.md`` for a worked example.
+    """
+    if not callable(builder):
+        raise TypeError("builder must be callable(svc_cfg, policy) -> ModelBundle")
+    if name in MODEL_REGISTRY:
+        log.warning("register_model: overriding existing model %r", name)
+    MODEL_REGISTRY[name] = builder
+
+
 def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
     if policy is None:
         from ..runtime.device import default_policy

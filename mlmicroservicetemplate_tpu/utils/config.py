@@ -48,9 +48,10 @@ class ServiceConfig(BaseModel):
     max_queue: int = 1024
     # Batches allowed in flight on the device concurrently. Dispatch and
     # result-fetch round-trips overlap (XLA queues the work), so >1
-    # hides host<->device transfer latency behind compute. Especially
-    # important when the TPU sits behind a relay with high RTT.
-    pipeline_depth: int = 4
+    # hides host<->device transfer latency behind compute. Measured on a
+    # relay-attached v5e: 4 -> 66.8 req/s, 8 -> 83.0, 12 -> regression
+    # (thread thrash). CPU-backend hosts may prefer a lower value.
+    pipeline_depth: int = 8
 
     # Static-shape buckets (L2). XLA compiles one executable per shape;
     # requests are padded up to the nearest bucket (SURVEY.md §7.4.1).

@@ -1,0 +1,86 @@
+"""Template extension point: a user-registered model serves through the
+full stack (build_model → engine → batcher → HTTP) untouched."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from aiohttp.test_utils import TestClient, TestServer
+
+from mlmicroservicetemplate_tpu import register_model
+from mlmicroservicetemplate_tpu.api import build_app
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.models import ModelBundle, build_model
+from mlmicroservicetemplate_tpu.models.registry import MODEL_REGISTRY
+from mlmicroservicetemplate_tpu.models.tokenizer import build_tokenizer
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.scheduler import Batcher
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+
+def _build_sentiment_mlp(svc_cfg, policy):
+    vocab, d, n_labels = 261, 32, 2
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {
+        "emb": jax.random.normal(k1, (vocab, d)) * 0.02,
+        "out": jax.random.normal(k2, (d, n_labels)) * 0.02,
+    }
+
+    def forward(p, input_ids, attention_mask):
+        x = jnp.take(p["emb"], input_ids, axis=0)
+        denom = jnp.maximum(attention_mask.sum(-1, keepdims=True), 1)
+        pooled = (x * attention_mask[..., None]).sum(1) / denom
+        return (pooled @ p["out"]).astype(jnp.float32)
+
+    return ModelBundle(
+        name="sentiment-mlp", kind="text_classification", cfg=None,
+        params=params, policy=policy,
+        tokenizer=build_tokenizer(svc_cfg.tokenizer_path),
+        labels=["negative", "positive"], forward=forward,
+    )
+
+
+@pytest.fixture()
+def registered():
+    register_model("sentiment-mlp", _build_sentiment_mlp)
+    yield
+    MODEL_REGISTRY.pop("sentiment-mlp", None)
+
+
+def test_custom_model_serves_end_to_end(registered):
+    async def main():
+        cfg = ServiceConfig(
+            device="cpu", model_name="sentiment-mlp", warmup=False,
+            batch_buckets=(1, 2), seq_buckets=(16, 32), batch_timeout_ms=1.0,
+        )
+        bundle = build_model(cfg)
+        engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(engine, cfg)
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(100):
+                if (await client.get("/readyz")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            resp = await client.post("/predict", json={"text": "loved it"})
+            assert resp.status == 200
+            out = await resp.json()
+            assert out["model"] == "sentiment-mlp"
+            assert out["prediction"]["label"] in ("negative", "positive")
+            st = await (await client.get("/status")).json()
+            assert st["model"] == "sentiment-mlp"
+        finally:
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_register_model_validates():
+    with pytest.raises(TypeError):
+        register_model("bad", "not-a-callable")
