@@ -12,7 +12,12 @@ RUN pip install --no-cache-dir -r requirements.txt
 
 COPY mlmicroservicetemplate_tpu/ mlmicroservicetemplate_tpu/
 
-ENV DEVICE=tpu \
+# Default to the device the image's requirements actually install
+# (CPU jax).  TPU deployments set DEVICE=tpu explicitly AND install
+# the TPU runtime (uncomment jax[tpu] in requirements.txt / bake
+# libtpu per fleet convention) — a tpu default with a cpu-only wheel
+# would crash at startup and loop the healthcheck.
+ENV DEVICE=cpu \
     MODEL_NAME=resnet50 \
     HOST=0.0.0.0 \
     PORT=8000 \

@@ -34,6 +34,9 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app["batcher"] = batcher
     app["ready"] = asyncio.Event()
     app["started_at"] = time.time()
+    # Mutable runtime state lives in one dict: aiohttp freezes the app
+    # mapping once started, so post-startup writes must go through this.
+    app["state"] = {"ready_error": None, "warmup_s": None, "tracing": False}
 
     app.router.add_post("/predict", handle_predict)
     app.router.add_get("/healthz", handle_healthz)
@@ -52,13 +55,25 @@ async def _on_startup(app: web.Application) -> None:
     await batcher.start()
 
     async def warm_then_ready():
-        if cfg.warmup:
-            loop = asyncio.get_running_loop()
-            await loop.run_in_executor(None, engine.warmup)
-        else:
-            # Canary dispatch: readiness means "the device answers",
-            # not just "the process is up".
-            await _canary(app)
+        # Failures here must be loud and visible: a swallowed warmup
+        # exception leaves the server not-ready forever with zero
+        # diagnostic.  The error is logged AND surfaced via /readyz.
+        try:
+            if cfg.warmup:
+                loop = asyncio.get_running_loop()
+                app["state"]["warmup_s"] = await loop.run_in_executor(
+                    None, engine.warmup
+                )
+            else:
+                # Canary dispatch: readiness means "the device answers",
+                # not just "the process is up".
+                await _canary(app)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            app["state"]["ready_error"] = f"{type(e).__name__}: {e}"
+            log.exception("warmup/canary failed; server will stay not-ready")
+            return
         app["ready"].set()
         log.info("model %s ready", app["bundle"].name)
 
@@ -136,7 +151,13 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
     app = request.app
     bundle: ModelBundle = app["bundle"]
     t0 = time.monotonic()
-    item = await _parse_request(request)
+    try:
+        item = await _parse_request(request)
+    except web.HTTPBadRequest:
+        # Parse-level 400s must show up in /metrics like every other
+        # terminal status — error rates are an observability surface.
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise
     stream = item.stream or request.query.get("stream", "") in ("1", "true")
 
     loop = asyncio.get_running_loop()
@@ -155,6 +176,12 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
     except QueueFullError:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
         raise web.HTTPServiceUnavailable(reason="batch queue full, retry later")
+    except Exception:
+        # Engine/dispatch failure: surface as a clean 500 (with a metric
+        # and a server-side traceback), not an opaque aiohttp error page.
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception("inference dispatch failed")
+        raise web.HTTPInternalServerError(reason="inference failed")
     result = await loop.run_in_executor(None, bundle.postprocess, row)
     dt = time.monotonic() - t0
     result["model"] = bundle.name
@@ -187,6 +214,11 @@ async def _stream_predict(
     prev_text = ""
     decode_steps = 0
     try:
+        # On ANY exit — client disconnect mid-write included — close the
+        # stream generator explicitly so the batcher's pump sees
+        # `cancelled` now, not whenever GC finalizes the generator; an
+        # abandoned stream must stop dispatching device chunks at the
+        # next boundary.
         async for chunk in stream_iter:
             decode_steps += int(chunk.size)
             for t in chunk.tolist():
@@ -221,7 +253,11 @@ async def _stream_predict(
         metrics.REQUESTS.labels(bundle.name, "200").inc()
         metrics.LATENCY.labels(bundle.name).observe(dt)
     finally:
-        await resp.write_eof()
+        await stream_iter.aclose()
+        try:
+            await resp.write_eof()
+        except ConnectionError:
+            pass  # client already gone; nothing left to finalize
     return resp
 
 
@@ -236,7 +272,11 @@ async def handle_healthz(request: web.Request) -> web.Response:
 async def handle_readyz(request: web.Request) -> web.Response:
     if request.app["ready"].is_set():
         return web.json_response({"ready": True})
-    return web.json_response({"ready": False}, status=503)
+    body = {"ready": False}
+    err = request.app["state"]["ready_error"]
+    if err:
+        body["error"] = err
+    return web.json_response(body, status=503)
 
 
 async def handle_status(request: web.Request) -> web.Response:
@@ -245,17 +285,30 @@ async def handle_status(request: web.Request) -> web.Response:
     bundle: ModelBundle = app["bundle"]
     import jax
 
-    return web.json_response(
-        {
-            "model": bundle.name,
-            "kind": bundle.kind,
-            "ready": app["ready"].is_set(),
-            "device": jax.default_backend(),
-            "n_devices": app["engine"].replicas.n_replicas,
-            "max_batch": app["cfg"].max_batch,
-            "uptime_s": round(time.time() - app["started_at"], 1),
-        }
-    )
+    engine = app["engine"]
+    body = {
+        "model": bundle.name,
+        "kind": bundle.kind,
+        "ready": app["ready"].is_set(),
+        "device": jax.default_backend(),
+        "n_devices": engine.replicas.n_replicas,
+        "max_batch": app["cfg"].max_batch,
+        "uptime_s": round(time.time() - app["started_at"], 1),
+        # Compiled-executable inventory + startup cost: the operator-
+        # facing answer to "what shapes are warm and what did warming
+        # them cost" (each bucket is one XLA executable).
+        "batch_buckets": list(engine.batch_buckets),
+        "seq_buckets": list(engine.seq_buckets),
+        "warmup_s": (
+            round(app["state"]["warmup_s"], 3)
+            if app["state"]["warmup_s"] is not None
+            else None
+        ),
+    }
+    err = app["state"]["ready_error"]
+    if err:
+        body["ready_error"] = err
+    return web.json_response(body)
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -284,9 +337,9 @@ async def handle_trace(request: web.Request) -> web.Response:
     # client-controlled — this endpoint must not become an
     # arbitrary-path file-write primitive.
     trace_dir = os.environ.get("JAX_TRACE_DIR", "/tmp/jax-trace")
-    if request.app.get("_tracing"):
+    if request.app["state"]["tracing"]:
         raise web.HTTPConflict(reason="a trace is already running")
-    request.app["_tracing"] = True
+    request.app["state"]["tracing"] = True
     import jax
 
     try:
@@ -297,7 +350,7 @@ async def handle_trace(request: web.Request) -> web.Response:
             jax.profiler.stop_trace()
         except Exception as e:
             log.warning("stop_trace failed: %s", e)
-        request.app["_tracing"] = False
+        request.app["state"]["tracing"] = False
     return web.json_response(
         {"trace_dir": trace_dir, "seconds": seconds,
          "hint": "open in perfetto or tensorboard --logdir"}

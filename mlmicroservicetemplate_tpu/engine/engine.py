@@ -28,6 +28,7 @@ import numpy as np
 
 from ..models.registry import KIND_IMAGE, KIND_SEQ2SEQ, KIND_TEXT, ModelBundle
 from ..parallel import ReplicaSet, make_mesh
+from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
@@ -83,8 +84,40 @@ class InferenceEngine:
                 return bundle.generate_chunk_fn(p, state, n_steps)
 
             self._start = jax.jit(start, static_argnums=(3, 4))
+
+            # Non-streaming generate: encode + init + a done-aware
+            # while_loop of chunk scans, still ONE dispatch.  An
+            # all-EOS batch exits at the next chunk boundary instead of
+            # paying the full max_decode_len scan on the device.
+            def full(p, ids, mask, max_len: int, chunk: int):
+                import jax.numpy as jnp
+                from jax import lax
+
+                enc = bundle.encode_fn(p, ids, mask)
+                state = bundle.init_state_fn(p, enc, mask, max_len)
+                # Bucket-padding rows (all-zero mask) never emit EOS, so
+                # they must count as done from the start or the early
+                # exit could never fire on any padded batch.
+                state = state._replace(done=state.done | (mask.sum(axis=-1) == 0))
+
+                def cond(s):
+                    import jax.numpy as jnp
+
+                    return jnp.logical_and(s.pos < max_len, ~s.done.all())
+
+                def body(s):
+                    s, _ = bundle.generate_chunk_fn(p, s, chunk)
+                    return s
+
+                state = lax.while_loop(cond, body, state)
+                return state.tokens, state.pos
+
+            self._full = jax.jit(full, static_argnums=(3, 4))
         else:
             self._forward = jax.jit(bundle.forward)
+        # Decode steps actually executed by the most recent non-streaming
+        # seq2seq dispatch (early-exit observability; also in /metrics).
+        self.last_decode_steps: int | None = None
 
     # ------------------------------------------------------------------
     # collation: list of per-item feature dicts -> padded device batch
@@ -143,14 +176,22 @@ class InferenceEngine:
                 ids, mask, n = self._collate_text(feats)
                 ids, mask = self.replicas.place_batch(ids, mask)
                 logits = self._forward(self.params, ids, mask)
-            else:  # seq2seq, non-streaming: ONE dispatch for the whole
-                # encode + init + full decode scan
+            else:  # seq2seq, non-streaming: ONE dispatch for encode +
+                # init + done-aware chunked decode (early EOS exit)
                 ids, mask, n = self._collate_text(feats)
                 ids, mask = self.replicas.place_batch(ids, mask)
-                state, _ = self._start(
-                    self.params, ids, mask, self.max_decode_len, self.max_decode_len
+                tokens, steps = self._full(
+                    self.params, ids, mask, self.max_decode_len, self.chunk_tokens
                 )
-                logits = state.tokens
+                # tokens + step count in ONE transfer (each device_get
+                # pays a full relay round-trip).
+                rows, steps_np = jax.device_get((tokens, steps))
+                rows = np.asarray(rows)
+                self.last_decode_steps = int(steps_np)
+                metrics.DECODE_STEPS.labels(self.bundle.name).observe(
+                    self.last_decode_steps
+                )
+                return [rows[i] for i in range(n)]
             rows = np.asarray(jax.device_get(logits))
         return [rows[i] for i in range(n)]
 

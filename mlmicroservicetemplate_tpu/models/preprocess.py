@@ -55,14 +55,6 @@ def normalize_imagenet(x):
     return (x.astype(jnp.float32) / 255.0 - mean) / std
 
 
-def decode_image(data: bytes, image_size: int = 224) -> np.ndarray:
-    """JPEG/PNG bytes → normalized [H, W, 3] f32 (host-side normalize;
-    the serving path uses ``decode_image_u8`` + device-side
-    ``normalize_imagenet`` instead)."""
-    x = decode_image_u8(data, image_size).astype(np.float32) / 255.0
-    return (x - IMAGENET_MEAN) / IMAGENET_STD
-
-
 def softmax_np(logits: np.ndarray) -> np.ndarray:
     z = logits - logits.max(axis=-1, keepdims=True)
     e = np.exp(z)

@@ -116,11 +116,24 @@ class Batcher:
 
         def pump():
             try:
-                for chunk in self.engine.generate_stream(feats):
-                    loop.call_soon_threadsafe(chunks.put_nowait, chunk)
-                    metrics.TOKENS.labels(self.model).inc(int(chunk.size))
-                    if cancelled.is_set():
-                        return
+                gen = self.engine.generate_stream(feats)
+                try:
+                    while True:
+                        # Check BEFORE asking the engine for the next
+                        # chunk: a disconnected client pays at most the
+                        # one dispatch already in flight, never a fresh
+                        # one (the generator only touches the device
+                        # inside next()).
+                        if cancelled.is_set():
+                            return
+                        try:
+                            chunk = next(gen)
+                        except StopIteration:
+                            break
+                        loop.call_soon_threadsafe(chunks.put_nowait, chunk)
+                        metrics.TOKENS.labels(self.model).inc(int(chunk.size))
+                finally:
+                    gen.close()
                 loop.call_soon_threadsafe(chunks.put_nowait, _END)
             except BaseException as e:  # propagate to the consumer
                 loop.call_soon_threadsafe(chunks.put_nowait, e)

@@ -73,6 +73,12 @@ BATCH_SIZE = Histogram(
 )
 QUEUE_DEPTH = Gauge("batch_queue_depth", "Requests currently queued", ["model"])
 TOKENS = Counter("generated_tokens_total", "Seq2seq tokens generated", ["model"])
+DECODE_STEPS = Histogram(
+    "seq2seq_decode_steps",
+    "Decode steps executed per non-streaming seq2seq dispatch "
+    "(< max_decode_len when the whole batch hit EOS early)",
+    ["model"], buckets=(4, 8, 16, 32, 64, 128, 256),
+)
 
 
 def render() -> tuple[bytes, str]:

@@ -125,3 +125,60 @@ def test_stream_bridge():
         assert [int(c[0]) for c in chunks] == [70, 71, 72]
 
     asyncio.run(_with_batcher(_cfg(), eng, body))
+
+
+def test_stream_cancel_stops_dispatch():
+    """Closing the stream generator (client disconnect) must stop the
+    pump BEFORE its next engine dispatch: at most the one chunk already
+    in flight is paid, and the stream slot is released."""
+
+    class Slow(FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.chunks_dispatched = 0
+
+        def generate_stream(self, feats):
+            for i in range(50):
+                self.chunks_dispatched += 1
+                time.sleep(0.05)  # one slow device dispatch per chunk
+                yield np.array([i])
+
+    eng = Slow()
+
+    async def body(b):
+        gen = b.submit_stream({"id": 1})
+        first = await gen.__anext__()
+        assert int(first[0]) == 0
+        await gen.aclose()  # client gone
+        n_at_close = eng.chunks_dispatched
+        # Pump must exit promptly and release its slot...
+        for _ in range(200):
+            if b._active_streams == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert b._active_streams == 0
+        # ...and pay at most ONE dispatch beyond the point of close
+        # (the one that was already in flight when `cancelled` was set).
+        assert eng.chunks_dispatched <= n_at_close + 1
+        assert eng.chunks_dispatched < 50
+
+    asyncio.run(_with_batcher(_cfg(), eng, body))
+
+
+def test_stop_drains_inflight():
+    """stop() under load: every request submitted before stop resolves
+    (no dropped futures), even with dispatches still in flight."""
+    eng = FakeEngine(delay=0.05)
+
+    async def main():
+        b = Batcher(eng, _cfg(max_batch=2, batch_timeout_ms=1))
+        await b.start()
+        futs = [asyncio.ensure_future(b.submit({"id": i})) for i in range(6)]
+        await asyncio.sleep(0.01)  # let batches start forming/dispatching
+        await b.stop()
+        rows = await asyncio.gather(*futs)
+        assert sorted(int(r[0]) for r in rows) == list(range(6))
+        with pytest.raises(RuntimeError):
+            await b.submit({"id": 99})
+
+    asyncio.run(main())
