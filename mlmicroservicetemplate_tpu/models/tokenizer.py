@@ -179,7 +179,17 @@ class WordPieceTokenizer:
 
 
 def build_tokenizer(tokenizer_path: str | None, for_t5: bool = False):
-    """Tokenizer factory honoring TOKENIZER_PATH with byte-level fallback."""
+    """Tokenizer factory honoring TOKENIZER_PATH with byte-level fallback.
+
+    File-format routing: ``spiece.model`` / ``*.tsv`` / ``*.vocab`` →
+    SentencePiece unigram (the T5 family's real tokenizer); anything
+    else → WordPiece ``vocab.txt`` (BERT family).  ``for_t5`` only
+    shapes the no-asset byte fallback and SP eos behavior.
+    """
     if tokenizer_path:
+        if tokenizer_path.endswith((".model", ".tsv", ".vocab")):
+            from .sentencepiece import load_sentencepiece
+
+            return load_sentencepiece(tokenizer_path, add_eos=for_t5)
         return WordPieceTokenizer(tokenizer_path)
     return ByteTokenizer(add_cls_sep=not for_t5, add_eos=for_t5)
