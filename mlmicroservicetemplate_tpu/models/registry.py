@@ -190,8 +190,17 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                            t5_state_to_pytree)
     params = cast_pytree(params, policy.param_jnp)
 
+    # Same serving-only Pallas opt-in as BERT (the kernel has no VJP;
+    # the rel-pos bias rides into the fused kernel as a [1,H,S,S] block).
+    from ..ops.attention import use_pallas_attention
+
+    use_pallas = use_pallas_attention()
+
     def encode_fn(p, input_ids, attention_mask):
-        return t5_mod.encode(p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp)
+        return t5_mod.encode(
+            p, cfg, input_ids, attention_mask,
+            dtype=policy.compute_jnp, use_pallas=use_pallas,
+        )
 
     def init_state_fn(p, enc_out, enc_mask, max_len: int):
         return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len)

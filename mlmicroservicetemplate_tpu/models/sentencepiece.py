@@ -243,9 +243,14 @@ class SentencePieceTokenizer:
                 # OOV character s[i-1]: byte-fallback, else <unk>.
                 j = i - 1
                 ch = s[j]
-                if self.byte_pieces:
-                    ids = tuple(self.byte_pieces[b] for b in ch.encode("utf-8"))
+                byte_ids = tuple(
+                    self.byte_pieces.get(b) for b in ch.encode("utf-8")
+                )
+                if byte_ids and None not in byte_ids:
+                    ids = byte_ids
                 else:
+                    # No (or only partial) byte-piece coverage for this
+                    # character: whole char becomes <unk>.
                     ids = (self.unk_id,)
                 best[i] = best[j] + self._unk_score
                 back[i] = (j, ids)

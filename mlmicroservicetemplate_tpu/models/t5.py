@@ -159,12 +159,19 @@ def _position_bias(
 # blocks
 
 
-def _self_attention(p, cfg, x, mask, bias):
+def _self_attention(p, cfg, x, mask, bias, key_mask=None):
     q = split_heads(dense(p["q"], x), cfg.num_heads)
     k = split_heads(dense(p["k"], x), cfg.num_heads)
     v = split_heads(dense(p["v"], x), cfg.num_heads)
-    # T5 folds the 1/sqrt(d) into init: scale=1.
-    ctx = mha_attention(q, k, v, mask=mask, bias=bias, scale=1.0)
+    if key_mask is not None:
+        # Pallas fused path (opt-in, serving-only — no VJP/sharding):
+        # scores + rel-pos bias + softmax stay VMEM-resident.
+        from ..ops.attention import fused_attention
+
+        ctx = fused_attention(q, k, v, key_mask, bias=bias, scale=1.0)
+    else:
+        # T5 folds the 1/sqrt(d) into init: scale=1.
+        ctx = mha_attention(q, k, v, mask=mask, bias=bias, scale=1.0)
     return dense(p["out"], merge_heads(ctx))
 
 
@@ -174,6 +181,7 @@ def encode(
     input_ids: jax.Array,  # [B, S]
     attention_mask: jax.Array,  # [B, S]
     dtype=jnp.float32,
+    use_pallas: bool = False,
 ) -> jax.Array:
     s = input_ids.shape[1]
     x = embed(params["shared"], input_ids, dtype)
@@ -182,9 +190,12 @@ def encode(
     bias = _position_bias(
         params["encoder"]["layers"][0]["attn"]["rel_bias"], cfg, pos, pos, bidirectional=True
     )
+    # use_pallas is the CALLER's decision (serving wrapper only): the
+    # fused kernel has no VJP, so training consumers stay on jnp.
+    key_mask = attention_mask if use_pallas else None
     for layer in params["encoder"]["layers"]:
         h = rmsnorm(layer["attn_ln"], x)
-        x = x + _self_attention(layer["attn"], cfg, h, mask, bias)
+        x = x + _self_attention(layer["attn"], cfg, h, mask, bias, key_mask=key_mask)
         h = rmsnorm(layer["mlp_ln"], x)
         h = dense(layer["mlp"]["wo"], jax.nn.relu(dense(layer["mlp"]["wi"], h)))
         x = x + h

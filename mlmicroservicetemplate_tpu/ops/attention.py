@@ -11,9 +11,9 @@ Encoder sizes here (S ≤ 512, D = 64) fit whole heads in VMEM
 (512·512·4B scores + 3·512·64 tiles ≈ 1.3 MB of ~16 MB), so no online
 softmax is needed; this is the single-block regime, not FlashAttention.
 
-Serving-shape contract: no bias support (BERT/ResNet path; the T5
-encoder needs rel-pos bias and keeps the jnp path), optional padding
-mask, Sq == Sk.
+Serving-shape contract: optional additive bias [1, H, S, S] (T5's
+relative-position bias, shared across batch), optional padding mask,
+Sq == Sk.
 """
 
 from __future__ import annotations
@@ -27,8 +27,16 @@ import jax.numpy as jnp
 
 
 def use_pallas_attention() -> bool:
-    """Opt-in: USE_PALLAS_ATTENTION=1 and a TPU backend present."""
-    if os.environ.get("USE_PALLAS_ATTENTION", "").lower() not in ("1", "true", "yes"):
+    """Default ON for TPU serving; USE_PALLAS_ATTENTION=0 disables.
+
+    Measured wins (benchmarks/pallas_ab.py, v5e, device time isolated
+    from the relay): BERT-base B=32 S=512 1.13x; T5-small encoder B=8
+    S=512 2.10x.  The kernel is verified against the jnp path at every
+    serving seq bucket (32..512) in bf16 on real hardware.  Serving
+    call sites only — no VJP, so training/tp consumers stay on jnp.
+    """
+    env = os.environ.get("USE_PALLAS_ATTENTION", "").lower()
+    if env in ("0", "false", "no"):
         return False
     try:
         return jax.default_backend() == "tpu"
@@ -38,6 +46,16 @@ def use_pallas_attention() -> bool:
 
 def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float):
     # Block shapes: q/k/v [1, 1, S, D]; mask [1, 1, S]; o [1, 1, S, D].
+    _attn_body(q_ref, k_ref, v_ref, mask_ref, None, o_ref, scale=scale)
+
+
+def _attn_kernel_bias(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref, *, scale: float):
+    # As _attn_kernel plus an additive [1, 1, S, S] bias block (one head
+    # of the shared rel-pos bias); bias also stays VMEM-resident.
+    _attn_body(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref, scale=scale)
+
+
+def _attn_body(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref, *, scale: float):
     q = q_ref[0, 0].astype(jnp.float32)  # [S, D]
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0]
@@ -46,6 +64,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale  # [S, S]
+    if bias_ref is not None:
+        scores = scores + bias_ref[0, 0].astype(jnp.float32)
     mask = mask_ref[0]  # [1, S] int32, 1 = keep (key-side padding mask)
     scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
@@ -63,6 +83,7 @@ def fused_attention(
     k: jax.Array,  # [B, S, H, D]
     v: jax.Array,  # [B, S, H, D]
     mask: jax.Array,  # [B, S] 1 = keep
+    bias: jax.Array | None = None,  # [1, H, S, S] additive (T5 rel-pos)
     scale: float | None = None,
     interpret: bool = False,
 ) -> jax.Array:
@@ -80,12 +101,25 @@ def fused_attention(
     # TPU tiling wants the mask block's trailing dims to equal the array
     # dims, so carry it as [B, 1, S] with a (1, 1, S) block.
     mask3 = mask.astype(jnp.int32)[:, None, :]
+    mask_spec = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))
+    if bias is None:
+        kernel = functools.partial(_attn_kernel, scale=scale)
+        in_specs = [bhsd, bhsd, bhsd, mask_spec]
+        args = (qt, kt, vt, mask3)
+    else:
+        # One [S, S] head-slice of the shared bias per grid step.
+        kernel = functools.partial(_attn_kernel_bias, scale=scale)
+        in_specs = [
+            bhsd, bhsd, bhsd, mask_spec,
+            pl.BlockSpec((1, 1, s, s), lambda i, j: (0, j, 0, 0)),
+        ]
+        args = (qt, kt, vt, mask3, bias)
     out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale),
+        kernel,
         grid=(b, h),
-        in_specs=[bhsd, bhsd, bhsd, pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))],
+        in_specs=in_specs,
         out_specs=bhsd,
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, mask3)
+    )(*args)
     return jnp.transpose(out, (0, 2, 1, 3))
