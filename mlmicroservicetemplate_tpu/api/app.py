@@ -80,10 +80,10 @@ async def _on_startup(app: web.Application) -> None:
     app["_ready_task"] = asyncio.get_running_loop().create_task(warm_then_ready())
 
     if cfg.server_url:
-        from .registration import register_with_parent
+        from .registration import registration_loop
 
         app["_register_task"] = asyncio.get_running_loop().create_task(
-            register_with_parent(cfg, app["bundle"].name)
+            registration_loop(cfg, app["bundle"].name)
         )
 
 

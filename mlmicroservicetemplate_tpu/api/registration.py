@@ -38,3 +38,21 @@ async def register_with_parent(cfg, model_name: str) -> bool:
             await asyncio.sleep(cfg.register_retry_s)
     log.error("giving up registering with %s after %d tries", url, cfg.register_max_tries)
     return False
+
+
+async def registration_loop(cfg, model_name: str) -> None:
+    """Register, then re-register every ``register_heartbeat_s`` so a
+    restarted parent re-learns this service without operator action.
+
+    The public template registers once and relies on the parent to poll
+    liveness (SURVEY.md §3.5); the heartbeat is the upgrade for the
+    parent-restart case.  Disabled when ``register_heartbeat_s`` <= 0
+    (register-once parity behavior).
+    """
+    await register_with_parent(cfg, model_name)
+    beat = float(getattr(cfg, "register_heartbeat_s", 0) or 0)
+    if beat <= 0:
+        return
+    while True:
+        await asyncio.sleep(beat)
+        await register_with_parent(cfg, model_name)

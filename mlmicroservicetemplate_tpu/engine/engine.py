@@ -51,7 +51,12 @@ class InferenceEngine:
 
         self.bundle = bundle
         self.cfg = cfg
-        self.replicas = replicas or ReplicaSet(make_mesh(getattr(cfg, "replicas", 0)))
+        if replicas is not None:
+            self.replicas = replicas
+        elif bundle.make_placement is not None:
+            self.replicas = bundle.make_placement()
+        else:
+            self.replicas = ReplicaSet(make_mesh(getattr(cfg, "replicas", 0)))
         self.params = self.replicas.place_params(bundle.params)
         self.batch_buckets = tuple(sorted(cfg.batch_buckets))
         self.seq_buckets = tuple(sorted(cfg.seq_buckets))
@@ -140,7 +145,9 @@ class InferenceEngine:
         n = len(feats)
         bsz = bucket_for(n, self.batch_buckets, self._pad_multiple())
         max_len = max(int(f["length"]) for f in feats)
-        seq = bucket_for(max_len, self.seq_buckets)
+        # Sequence-parallel placements shard axis 1: the seq bucket must
+        # divide by the mesh width (ReplicaSet reports 1).
+        seq = bucket_for(max_len, self.seq_buckets, self.replicas.seq_multiple())
         ids = np.zeros((bsz, seq), np.int32)
         mask = np.zeros((bsz, seq), np.int32)
         for i, f in enumerate(feats):

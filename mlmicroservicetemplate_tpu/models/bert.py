@@ -84,14 +84,19 @@ def _layer(
     x: jax.Array,
     mask: jax.Array,
     key_mask: jax.Array | None = None,
+    attn_fn=None,
 ) -> jax.Array:
     a = p["attn"]
     q = split_heads(dense(a["q"], x), cfg.num_heads)
     k = split_heads(dense(a["k"], x), cfg.num_heads)
     v = split_heads(dense(a["v"], x), cfg.num_heads)
-    if key_mask is not None:
+    if attn_fn is not None:
+        # Pluggable attention core (q, k, v, key_mask[B,S]) -> ctx —
+        # the long-context path injects ring attention here.
+        ctx = merge_heads(attn_fn(q, k, v, key_mask))
+    elif key_mask is not None:
         # Pallas fused path: scores/softmax stay VMEM-resident
-        # (opt-in via USE_PALLAS_ATTENTION, see ops/attention.py).
+        # (default on TPU serving, see ops/attention.py).
         from ..ops.attention import fused_attention
 
         ctx = merge_heads(fused_attention(q, k, v, key_mask))
@@ -111,6 +116,7 @@ def encode(
     token_type_ids: jax.Array | None = None,
     dtype=jnp.float32,
     use_pallas: bool = False,
+    attn_fn=None,
 ) -> jax.Array:
     """Returns the final hidden states [B, S, D]."""
     b, s = input_ids.shape
@@ -124,9 +130,9 @@ def encode(
     # use_pallas must be decided by the CALLER (the serving wrapper):
     # the kernel has no VJP and no sharding awareness, so training and
     # tp-sharded consumers of encode() stay on the jnp path.
-    key_mask = attention_mask if use_pallas else None
+    key_mask = attention_mask if (use_pallas or attn_fn is not None) else None
     for layer in params["layers"]:
-        x = _layer(layer, cfg, x, mask, key_mask=key_mask)
+        x = _layer(layer, cfg, x, mask, key_mask=key_mask, attn_fn=attn_fn)
     return x
 
 
@@ -138,10 +144,12 @@ def classify(
     token_type_ids: jax.Array | None = None,
     dtype=jnp.float32,
     use_pallas: bool = False,
+    attn_fn=None,
 ) -> jax.Array:
     """Sequence classification logits [B, num_labels] in f32 (the serving path)."""
     hidden = encode(
-        params, cfg, input_ids, attention_mask, token_type_ids, dtype, use_pallas
+        params, cfg, input_ids, attention_mask, token_type_ids, dtype, use_pallas,
+        attn_fn=attn_fn,
     )
     pooled = jnp.tanh(dense(params["pooler"], hidden[:, 0]).astype(jnp.float32))
     return dense(params["classifier"], pooled.astype(jnp.float32))

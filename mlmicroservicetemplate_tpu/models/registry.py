@@ -49,6 +49,10 @@ class ModelBundle:
     init_state_fn: Callable | None = None
     generate_chunk_fn: Callable | None = None
     image_size: int = 224
+    # Optional engine-placement override: () -> ReplicaSet-like. Lets a
+    # model pick a non-default sharding (bert-long uses SeqParallelSet:
+    # sequence axis over ('sp',) for ring attention).
+    make_placement: Callable | None = None
 
     # -- host-side single-item pre/post ------------------------------------
     def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
@@ -180,6 +184,70 @@ def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     )
 
 
+def _build_bert_long(svc_cfg, policy: DtypePolicy) -> ModelBundle:
+    """Long-context BERT classifier served with ring attention.
+
+    The sequence axis shards over an ``('sp',)`` mesh
+    (``parallel.SeqParallelSet``); every encoder layer's attention runs
+    as a ppermute ring (``parallel/ring.py``), so per-device score
+    memory is O((S/n)²) and S scales with the mesh instead of a single
+    chip's VMEM/HBM.  Capability beyond the reference (SURVEY.md §2
+    lists no long-context machinery); the serving stack — buckets,
+    batcher, API — is unchanged.  SP=<width> picks the mesh size
+    (0 = all visible devices); every seq bucket must divide by it.
+    """
+    from ..convert import bert_state_to_pytree
+    from ..parallel import SeqParallelSet, make_sp_mesh
+    from ..parallel.ring import make_ring_attention
+    from .common import cast_pytree
+
+    max_pos = max(max(svc_cfg.seq_buckets), 512)
+    cfg = bert_mod.BertConfig(max_position=max_pos)
+    params = _load_or_init("bert-long", svc_cfg.model_path,
+                           functools.partial(bert_mod.init_params, cfg=cfg),
+                           bert_state_to_pytree)
+    # A loaded checkpoint's position table must actually cover the long
+    # buckets: jnp.take CLAMPS out-of-range indices, so an undersized
+    # table would silently reuse its last row for every position past
+    # it — confidently wrong logits, no error. Fail at startup instead.
+    pos_rows = params["embeddings"]["position"]["embedding"].shape[0]
+    if pos_rows < max_pos:
+        raise ValueError(
+            f"bert-long needs a position-embedding table with >= {max_pos} "
+            f"rows for SEQ_BUCKETS={svc_cfg.seq_buckets}, but the loaded "
+            f"checkpoint has {pos_rows}; extend the table (e.g. interpolate) "
+            "or lower the buckets"
+        )
+    params = cast_pytree(params, policy.param_jnp)
+
+    mesh = make_sp_mesh(getattr(svc_cfg, "sp", 0))
+    width = mesh.devices.size
+    bad = [s for s in svc_cfg.seq_buckets if s % width]
+    if bad:
+        raise ValueError(
+            f"SEQ_BUCKETS {bad} not divisible by sp mesh width {width}"
+        )
+    ring = make_ring_attention(mesh)
+
+    def forward(p, input_ids, attention_mask):
+        return bert_mod.classify(
+            p, cfg, input_ids, attention_mask,
+            dtype=policy.compute_jnp, attn_fn=ring,
+        )
+
+    return ModelBundle(
+        name="bert-long",
+        kind=KIND_TEXT,
+        cfg=cfg,
+        params=params,
+        policy=policy,
+        tokenizer=build_tokenizer(svc_cfg.tokenizer_path, for_t5=False),
+        labels=load_labels(getattr(svc_cfg, "labels_path", None)),
+        forward=forward,
+        make_placement=lambda: SeqParallelSet(mesh),
+    )
+
+
 def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     from ..convert import t5_state_to_pytree
     from .common import cast_pytree
@@ -226,6 +294,7 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 MODEL_REGISTRY: dict[str, Callable] = {
     "resnet50": _build_resnet,
     "bert-base": _build_bert,
+    "bert-long": _build_bert_long,
     "t5-small": _build_t5,
 }
 # Aliases for HF-style names the reference's configs use.

@@ -10,4 +10,4 @@ hand-written communication layer (SURVEY.md §5 "Distributed
 communication backend").
 """
 
-from .mesh import ReplicaSet, make_mesh  # noqa: F401
+from .mesh import ReplicaSet, SeqParallelSet, make_mesh, make_sp_mesh  # noqa: F401

@@ -23,21 +23,25 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
-def make_mesh(n_replicas: int = 0, devices=None):
-    """Build a 1-D ``('replica',)`` mesh over the first ``n_replicas``
-    visible devices (0 = all)."""
+def _make_1d_mesh(axis: str, n_devices: int, devices, knob: str):
+    """1-D mesh over the first ``n_devices`` visible devices (0 = all)."""
     import jax
     from jax.sharding import Mesh
 
     devs = list(devices if devices is not None else jax.devices())
-    if n_replicas:
-        if n_replicas > len(devs):
+    if n_devices:
+        if n_devices > len(devs):
             raise ValueError(
-                f"REPLICAS={n_replicas} but only {len(devs)} devices visible"
+                f"{knob}={n_devices} but only {len(devs)} devices visible"
             )
-        devs = devs[:n_replicas]
-    log.info("replica mesh over %d device(s): %s", len(devs), devs)
-    return Mesh(np.array(devs), ("replica",))
+        devs = devs[:n_devices]
+    log.info("%s mesh over %d device(s): %s", axis, len(devs), devs)
+    return Mesh(np.array(devs), (axis,))
+
+
+def make_mesh(n_replicas: int = 0, devices=None):
+    """``('replica',)`` mesh for data-parallel serving."""
+    return _make_1d_mesh("replica", n_replicas, devices, "REPLICAS")
 
 
 class ReplicaSet:
@@ -53,7 +57,12 @@ class ReplicaSet:
 
         self.mesh = mesh
         self.param_sharding = NamedSharding(mesh, P())
-        self.batch_sharding = NamedSharding(mesh, P("replica"))
+        self.batch_sharding = NamedSharding(mesh, self._batch_spec())
+
+    def _batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("replica")
 
     @property
     def n_replicas(self) -> int:
@@ -76,4 +85,38 @@ class ReplicaSet:
         return placed if len(placed) != 1 else placed[0]
 
     def pad_multiple(self) -> int:
+        return self.n_replicas
+
+    def seq_multiple(self) -> int:
+        """Divisibility the SEQ bucket must honor (1 = unconstrained).
+        Part of the placement contract the engine collates against."""
+        return 1
+
+
+def make_sp_mesh(n_devices: int = 0, devices=None):
+    """``('sp',)`` mesh for sequence-parallel (ring attention) serving."""
+    return _make_1d_mesh("sp", n_devices, devices, "SP")
+
+
+class SeqParallelSet(ReplicaSet):
+    """Engine placement for sequence-parallel (long-context) serving.
+
+    Same contract as ``ReplicaSet`` but the SEQUENCE axis (axis 1 of
+    [B, S] batch arrays) is sharded over ``('sp',)`` while the batch
+    axis stays whole on every device — the layout ring attention
+    consumes (``parallel/ring.py``): each device holds its local Q and
+    K/V blocks; K/V blocks rotate over ICI via ppermute.
+    """
+
+    def _batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P(None, "sp")
+
+    def pad_multiple(self) -> int:
+        # Batch sizes need no divisibility; the SEQ bucket must divide
+        # by the mesh width instead.
+        return 1
+
+    def seq_multiple(self) -> int:
         return self.n_replicas

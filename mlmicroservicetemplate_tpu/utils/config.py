@@ -65,6 +65,10 @@ class ServiceConfig(BaseModel):
     # Replica data-parallel serving (the NCCL-DataParallel equivalent).
     # 0 = use every visible device.
     replicas: int = 0
+    # Sequence-parallel width for long-context models (bert-long): the
+    # sequence axis shards over an ('sp',) mesh and attention runs as a
+    # ppermute ring (parallel/ring.py). 0 = every visible device.
+    sp: int = 0
 
     # Seq2seq decoding (T5).
     max_decode_len: int = 64
@@ -79,6 +83,9 @@ class ServiceConfig(BaseModel):
     server_url: str | None = None
     register_retry_s: float = 2.0
     register_max_tries: int = 30
+    # Re-register every N seconds so a restarted parent re-learns this
+    # service; 0 disables (register-once, template-parity behavior).
+    register_heartbeat_s: float = 0.0
 
     # Observability.
     log_level: str = "INFO"
@@ -140,6 +147,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "max_batch": "MAX_BATCH",
         "max_queue": "MAX_QUEUE",
         "replicas": "REPLICAS",
+        "sp": "SP",
         "max_seq_len": "MAX_SEQ_LEN",
         "max_decode_len": "MAX_DECODE_LEN",
         "pipeline_depth": "PIPELINE_DEPTH",
@@ -152,6 +160,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("BATCH_TIMEOUT_MS")
     if v is not None:
         kwargs["batch_timeout_ms"] = float(v)
+    v = get("REGISTER_HEARTBEAT_S")
+    if v is not None:
+        kwargs["register_heartbeat_s"] = float(v)
     # Comma-separated bucket overrides, e.g. BATCH_BUCKETS=1,8,32 — used
     # to bound warmup compile time when only some shapes will be served.
     for field, var in (("batch_buckets", "BATCH_BUCKETS"), ("seq_buckets", "SEQ_BUCKETS")):

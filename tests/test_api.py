@@ -335,3 +335,43 @@ def test_registration_client():
             await server.close()
 
     asyncio.run(main())
+
+
+def test_registration_heartbeat_reregisters():
+    """With REGISTER_HEARTBEAT_S set, the loop re-registers, so a
+    restarted parent re-learns the service."""
+
+    from aiohttp import web
+
+    from mlmicroservicetemplate_tpu.api.registration import registration_loop
+
+    async def main():
+        count = {"n": 0}
+
+        async def register(request):
+            count["n"] += 1
+            return web.json_response({"ok": True})
+
+        parent = web.Application()
+        parent.router.add_post("/register", register)
+        server = TestServer(parent)
+        await server.start_server()
+        try:
+            cfg = _cfg(
+                server_url=f"http://localhost:{server.port}",
+                register_retry_s=0.01,
+                register_max_tries=3,
+                register_heartbeat_s=0.05,
+            )
+            task = asyncio.create_task(registration_loop(cfg, "bert-base"))
+            await asyncio.sleep(0.35)
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            assert count["n"] >= 3, count  # initial + >=2 heartbeats
+        finally:
+            await server.close()
+
+    asyncio.run(main())
