@@ -10,12 +10,14 @@ materialized straight into HBM by the runtime with a chosen sharding.
 
 from .hf_maps import (
     bert_state_to_pytree,
+    gpt2_state_to_pytree,
     resnet_state_to_pytree,
     t5_state_to_pytree,
 )
 
 __all__ = [
     "bert_state_to_pytree",
+    "gpt2_state_to_pytree",
     "resnet_state_to_pytree",
     "t5_state_to_pytree",
 ]

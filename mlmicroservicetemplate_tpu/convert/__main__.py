@@ -22,6 +22,7 @@ CONVERTERS = {
     "resnet50": "resnet_state_to_pytree",
     "bert-base": "bert_state_to_pytree",
     "t5-small": "t5_state_to_pytree",
+    "gpt2": "gpt2_state_to_pytree",
 }
 
 

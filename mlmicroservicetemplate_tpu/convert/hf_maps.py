@@ -178,3 +178,46 @@ def t5_state_to_pytree(state: State, n_layers: int = 6) -> dict:
     if "lm_head.weight" in state:
         p["lm_head"] = {"kernel": _lin(state["lm_head.weight"])}
     return p
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 (HF GPT2LMHeadModel)
+
+
+def gpt2_state_to_pytree(state: State, n_layers: int = 12) -> dict:
+    """HF ``transformer.*`` names → ``models/gpt.init_params`` layout.
+
+    GPT-2's linear layers are HF ``Conv1D`` modules whose weights are
+    already stored [in, out] — the one transformer family where NO
+    transpose is needed (unlike nn.Linear's [out, in]).
+    """
+
+    def ln(prefix: str) -> dict:
+        return {"scale": state[f"{prefix}.weight"], "bias": state[f"{prefix}.bias"]}
+
+    def conv1d(prefix: str) -> dict:
+        return {"kernel": state[f"{prefix}.weight"], "bias": state[f"{prefix}.bias"]}
+
+    p: dict = {
+        "wte": {"embedding": state["transformer.wte.weight"]},
+        "wpe": {"embedding": state["transformer.wpe.weight"]},
+        "layers": [],
+        "final_ln": ln("transformer.ln_f"),
+    }
+    for i in range(n_layers):
+        b = f"transformer.h.{i}"
+        p["layers"].append(
+            {
+                "ln1": ln(f"{b}.ln_1"),
+                "attn": {
+                    "qkv": conv1d(f"{b}.attn.c_attn"),
+                    "out": conv1d(f"{b}.attn.c_proj"),
+                },
+                "ln2": ln(f"{b}.ln_2"),
+                "mlp": {
+                    "up": conv1d(f"{b}.mlp.c_fc"),
+                    "down": conv1d(f"{b}.mlp.c_proj"),
+                },
+            }
+        )
+    return p

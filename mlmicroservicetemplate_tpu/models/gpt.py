@@ -1,0 +1,277 @@
+"""Decoder-only causal LM (GPT-2 family), pure-JAX, KV-cached decode.
+
+Model-family breadth beyond the reference's three configs (SURVEY.md §2
+serves ResNet/BERT/T5): the template contract is "bring a model, get
+the serving stack" — this is the decoder-only member, servable as
+``MODEL_NAME=gpt2`` with streaming generation through the SAME engine
+machinery as T5 (encode/init/generate_chunk trio, single-dispatch
+chunked scans, early EOS exit).
+
+Architecture (GPT-2): learned positions, pre-LN blocks, GELU MLP,
+causal attention, tied LM head, final LN.
+
+TPU-first decode design: the prompt is prefilled in ONE forward (K/V
+for all prompt positions written into static [B, S+max_decode, H, D]
+caches), then generation runs as ``lax.scan`` chunks with per-row write
+indices — right-padded prompts of different lengths decode correctly in
+one batch because each row embeds/attends at its own position, with a
+key-validity mask instead of a shared causal frontier.
+
+The first decode step recomputes the last prompt position (its cache
+write is bit-identical to prefill's), which buys a uniform step
+function with no special first-token path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    Params,
+    dense,
+    dense_init,
+    embed,
+    layernorm,
+    layernorm_init,
+    merge_heads,
+    mha_attention,
+    normal_init,
+    split_heads,
+)
+
+
+def gelu_new(x: jax.Array) -> jax.Array:
+    # GPT-2 uses the tanh-approximated GELU ("gelu_new" in HF), not the
+    # erf form BERT uses — checkpoint fidelity depends on matching it.
+    return jax.nn.gelu(x, approximate=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    d_model: int = 768
+    num_heads: int = 12
+    num_layers: int = 12
+    d_ff: int = 3072
+    max_position: int = 1024
+    ln_eps: float = 1e-5
+    eos_id: int = 50256
+    pad_id: int = 50256  # GPT-2 has no pad token; eos doubles as pad
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+
+
+def init_params(key, cfg: GPTConfig = GPTConfig()) -> Params:
+    keys = jax.random.split(key, cfg.num_layers + 2)
+    d = cfg.d_model
+    params: Params = {
+        "wte": {"embedding": normal_init(keys[0], (cfg.vocab_size, d), std=0.02)},
+        "wpe": {"embedding": normal_init(keys[1], (cfg.max_position, d), std=0.01)},
+        "layers": [],
+        "final_ln": layernorm_init(d),
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[2 + i], 4)
+        params["layers"].append(
+            {
+                "ln1": layernorm_init(d),
+                "attn": {
+                    "qkv": dense_init(k[0], d, 3 * d, std=0.02),
+                    "out": dense_init(k[1], d, d, std=0.02),
+                },
+                "ln2": layernorm_init(d),
+                "mlp": {
+                    "up": dense_init(k[2], d, cfg.d_ff, std=0.02),
+                    "down": dense_init(k[3], cfg.d_ff, d, std=0.02),
+                },
+            }
+        )
+    return params
+
+
+def _qkv(p, cfg: GPTConfig, x):
+    qkv = dense(p["qkv"], x)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return (split_heads(t, cfg.num_heads) for t in (q, k, v))
+
+
+def _logits(params: Params, cfg: GPTConfig, x) -> jax.Array:
+    """Tied LM head; logits in f32 for exact argmax."""
+    w = params["wte"]["embedding"]
+    return x.astype(jnp.float32) @ w.astype(jnp.float32).T
+
+
+# ---------------------------------------------------------------------------
+# prefill (full prompt forward)
+
+
+def forward_hidden(
+    params: Params,
+    cfg: GPTConfig,
+    input_ids: jax.Array,  # [B, S]
+    attention_mask: jax.Array,  # [B, S]
+    dtype=jnp.float32,
+    collect_kv: bool = False,
+):
+    """Hidden states [B, S, D] (+ per-layer prompt K/V when collecting)."""
+    b, s = input_ids.shape
+    x = embed(params["wte"], input_ids, dtype)
+    x = x + embed(params["wpe"], jnp.arange(s, dtype=jnp.int32), dtype)[None]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    mask = causal[None, None] & (attention_mask[:, None, None, :] != 0)
+    kv = []
+    for layer in params["layers"]:
+        h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
+        q, k, v = _qkv(layer["attn"], cfg, h)
+        if collect_kv:
+            kv.append((k, v))
+        ctx = mha_attention(q, k, v, mask=mask)
+        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
+        x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
+    x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
+    return (x, kv) if collect_kv else x
+
+
+def lm_logits(
+    params: Params, cfg: GPTConfig, input_ids, attention_mask, dtype=jnp.float32
+) -> jax.Array:
+    """[B, S, V] next-token logits (the non-generative forward)."""
+    return _logits(params, cfg, forward_hidden(params, cfg, input_ids, attention_mask, dtype))
+
+
+# ---------------------------------------------------------------------------
+# incremental decode
+
+
+class GPTState(NamedTuple):
+    """Static-shape decode state; caches span prompt + decode budget."""
+
+    cache_k: Any  # per layer [B, S+Tmax, H, D]
+    cache_v: Any
+    key_valid: jax.Array  # [B, S+Tmax] int32 — 1 where cache rows are real
+    write_idx: jax.Array  # [B] int32 — position the NEXT step processes
+    pos: jax.Array  # [] int32 — decode steps taken (engine contract)
+    last_token: jax.Array  # [B] int32 — token the next step embeds
+    done: jax.Array  # [B] bool
+    tokens: jax.Array  # [B, Tmax] generated tokens (pad-filled)
+
+
+def init_decode_state(
+    params: Params,
+    cfg: GPTConfig,
+    input_ids: jax.Array,  # [B, S] right-padded
+    attention_mask: jax.Array,  # [B, S]
+    max_len: int,
+    dtype=jnp.float32,
+) -> GPTState:
+    b, s = input_ids.shape
+    total = s + max_len
+    _, kv = forward_hidden(
+        params, cfg, input_ids, attention_mask, dtype, collect_kv=True
+    )
+    cache_k, cache_v = [], []
+    for k, v in kv:
+        ck = jnp.zeros((b, total, cfg.num_heads, cfg.head_dim), k.dtype)
+        cache_k.append(ck.at[:, :s].set(k))
+        cache_v.append(ck.at[:, :s].set(v))
+    lengths = attention_mask.sum(axis=-1).astype(jnp.int32)  # [B]
+    key_valid = jnp.zeros((b, total), jnp.int32).at[:, :s].set(
+        attention_mask.astype(jnp.int32)
+    )
+    rows = jnp.arange(b)
+    # The first step re-processes the last prompt token at its own
+    # position (identical K/V overwrite), producing the first generated
+    # token's logits — one uniform step fn, no prefill/decode seam.
+    last_tok = input_ids[rows, jnp.maximum(lengths - 1, 0)]
+    return GPTState(
+        cache_k=cache_k,
+        cache_v=cache_v,
+        key_valid=key_valid,
+        write_idx=jnp.maximum(lengths - 1, 0),
+        pos=jnp.int32(0),
+        last_token=last_tok.astype(jnp.int32),
+        done=lengths == 0,  # fully-pad rows never generate
+        tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+    )
+
+
+def _decode_step(params: Params, cfg: GPTConfig, state: GPTState):
+    dtype = state.cache_k[0].dtype
+    b = state.last_token.shape[0]
+    rows = jnp.arange(b)
+    t = state.write_idx  # [B] per-row position
+    x = embed(params["wte"], state.last_token[:, None], dtype)  # [B,1,D]
+    x = x + embed(params["wpe"], t, dtype)[:, None]
+    key_valid = state.key_valid.at[rows, t].set(1)
+    attn_mask = (key_valid != 0)[:, None, None, :]  # [B,1,1,total]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B,1,H,D]
+        ck = state.cache_k[li].at[rows, t].set(k1[:, 0])
+        cv = state.cache_v[li].at[rows, t].set(v1[:, 0])
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(q, ck, cv, mask=attn_mask)
+        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
+        x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
+    x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
+    logits = _logits(params, cfg, x[:, 0])  # [B, V]
+
+    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
+    done = state.done | (next_tok == cfg.eos_id)
+    tokens = jax.lax.dynamic_update_slice_in_dim(
+        state.tokens, next_tok[:, None], state.pos, axis=1
+    )
+    new_state = GPTState(
+        cache_k=new_k,
+        cache_v=new_v,
+        key_valid=key_valid,
+        write_idx=t + 1,
+        pos=state.pos + 1,
+        last_token=next_tok,
+        done=done,
+        tokens=tokens,
+    )
+    return new_state, next_tok
+
+
+def generate_chunk(
+    params: Params, cfg: GPTConfig, state: GPTState, n_steps: int
+) -> tuple[GPTState, jax.Array]:
+    """``n_steps`` greedy decode steps in one compiled scan; returns
+    (state, [B, n_steps] tokens) — the engine's chunk contract."""
+
+    def step(s, _):
+        return _decode_step(params, cfg, s)
+
+    state, toks = jax.lax.scan(step, state, None, length=n_steps)
+    return state, jnp.transpose(toks)
+
+
+def greedy_generate(
+    params: Params,
+    cfg: GPTConfig,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    max_len: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Prefill + full decode scan, single dispatch → [B, max_len]."""
+    state = init_decode_state(params, cfg, input_ids, attention_mask, max_len, dtype)
+    state, _ = generate_chunk(params, cfg, state, max_len)
+    return state.tokens

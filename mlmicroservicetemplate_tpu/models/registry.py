@@ -53,6 +53,10 @@ class ModelBundle:
     # model pick a non-default sharding (bert-long uses SeqParallelSet:
     # sequence axis over ('sp',) for ring attention).
     make_placement: Callable | None = None
+    # Hard cap on tokenized prompt length (decoder-only models must
+    # leave position-table room for generation — jnp.take would clamp
+    # out-of-range positions silently otherwise).
+    max_prompt_len: int | None = None
 
     # -- host-side single-item pre/post ------------------------------------
     def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
@@ -63,7 +67,10 @@ class ModelBundle:
             return {"image": decode_image_u8(item.image, self.image_size)}
         if item.text is None:
             raise ValueError("this model expects a text payload")
-        max_len = self.cfg.max_position if hasattr(self.cfg, "max_position") else 512
+        if self.max_prompt_len is not None:
+            max_len = self.max_prompt_len
+        else:
+            max_len = self.cfg.max_position if hasattr(self.cfg, "max_position") else 512
         ids, mask = self.tokenizer.encode(item.text, max_len)
         n = int(mask.sum())
         return {"input_ids": ids[:n], "length": np.int32(n)}
@@ -291,11 +298,85 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     )
 
 
+def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
+    """Decoder-only causal LM (GPT-2), served through the seq2seq
+    engine machinery: "encode" passes the prompt through, init prefills
+    the KV caches in the same fused dispatch, chunks stream tokens.
+
+    Tokenizer: a real GPT-2 ``vocab.json`` (+ merges.txt) via
+    TOKENIZER_PATH; without one, the byte-level fallback is used and
+    eos/pad are remapped to its ids so EOS detection stays coherent.
+    """
+    from ..convert import gpt2_state_to_pytree
+    from . import gpt as gpt_mod
+    from .common import cast_pytree
+
+    tokenizer = build_tokenizer(svc_cfg.tokenizer_path, for_t5=True)
+    cfg = gpt_mod.GPTConfig(
+        eos_id=int(tokenizer.eos_id), pad_id=int(tokenizer.pad_id)
+    )
+    params = _load_or_init("gpt2", svc_cfg.model_path,
+                           functools.partial(gpt_mod.init_params, cfg=cfg),
+                           gpt2_state_to_pytree)
+    params = cast_pytree(params, policy.param_jnp)
+
+    # Decode positions run to prompt_len + max_decode_len; jnp.take
+    # CLAMPS past the wpe table (silently wrong logits), so (a) the
+    # seq buckets must leave decode headroom and (b) prompts are capped
+    # below it at preprocess time. Engine rounds the decode budget up
+    # to a whole number of stream chunks — mirror that here.
+    import math as _math
+
+    chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
+    decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
+    if decode_budget >= cfg.max_position:
+        raise ValueError(
+            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} leaves no room "
+            f"for a prompt within gpt2's {cfg.max_position} positions"
+        )
+    max_prompt = cfg.max_position - decode_budget
+    bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
+    if bad:
+        raise ValueError(
+            f"SEQ_BUCKETS {bad} exceed gpt2's position budget: max prompt = "
+            f"{cfg.max_position} positions - {decode_budget} decode = {max_prompt}"
+        )
+
+    def encode_fn(p, input_ids, attention_mask):
+        # Prompt passes through; the prefill forward happens in
+        # init_state_fn — both live inside the same fused jit dispatch.
+        return input_ids
+
+    def init_state_fn(p, input_ids, enc_mask, max_len: int):
+        return gpt_mod.init_decode_state(
+            p, cfg, input_ids, enc_mask, max_len, dtype=policy.compute_jnp
+        )
+
+    def generate_chunk_fn(p, state, n_steps: int):
+        return gpt_mod.generate_chunk(p, cfg, state, n_steps)
+
+    return ModelBundle(
+        name="gpt2",
+        kind=KIND_SEQ2SEQ,
+        cfg=cfg,
+        params=params,
+        policy=policy,
+        tokenizer=tokenizer,
+        labels=None,
+        forward=None,
+        encode_fn=encode_fn,
+        init_state_fn=init_state_fn,
+        generate_chunk_fn=generate_chunk_fn,
+        max_prompt_len=max_prompt,
+    )
+
+
 MODEL_REGISTRY: dict[str, Callable] = {
     "resnet50": _build_resnet,
     "bert-base": _build_bert,
     "bert-long": _build_bert_long,
     "t5-small": _build_t5,
+    "gpt2": _build_gpt,
 }
 # Aliases for HF-style names the reference's configs use.
 MODEL_REGISTRY["resnet-50"] = _build_resnet

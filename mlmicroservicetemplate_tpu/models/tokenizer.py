@@ -17,6 +17,7 @@ and ``decode(ids) -> text``.
 
 from __future__ import annotations
 
+import functools as _functools
 import unicodedata
 
 import numpy as np
@@ -178,18 +179,136 @@ class WordPieceTokenizer:
         return " ".join(toks)
 
 
+@_functools.lru_cache(maxsize=1)
+def _bytes_to_unicode() -> dict[int, str]:
+    """GPT-2's reversible byte→printable-unicode table (the standard
+    construction: printable latin bytes map to themselves, the rest to
+    256+n), so BPE operates on visible characters."""
+    bs = list(range(33, 127)) + list(range(161, 173)) + list(range(174, 256))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+class ByteLevelBPETokenizer:
+    """GPT-2 style byte-level BPE over ``vocab.json`` + ``merges.txt``.
+
+    Pure Python (no ``tokenizers`` wheel in this environment); uses the
+    exact GPT-2 split pattern via the installed ``regex`` module.
+    """
+
+    def __init__(self, vocab_path: str, merges_path: str | None = None):
+        import json
+        import os
+
+        import regex
+
+        if merges_path is None:
+            merges_path = os.path.join(os.path.dirname(vocab_path), "merges.txt")
+        with open(vocab_path, encoding="utf-8") as f:
+            self.vocab: dict[str, int] = json.load(f)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        with open(merges_path, encoding="utf-8") as f:
+            lines = [l.rstrip("\n") for l in f]
+        # Only the FIRST line is a header ("#version: ..."); real merges
+        # can legitimately start with '#' (e.g. the "# #" merge that
+        # builds the "##" token) and must not be filtered.
+        if lines and lines[0].startswith("#version"):
+            lines = lines[1:]
+        merges = [tuple(l.split()) for l in lines if l]
+        self.ranks = {pair: i for i, pair in enumerate(m for m in merges if len(m) == 2)}
+        self.byte_enc = _bytes_to_unicode()
+        self.byte_dec = {c: b for b, c in self.byte_enc.items()}
+        self.pat = regex.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+        )
+        self.eos_id = self.vocab.get("<|endoftext|>", len(self.vocab) - 1)
+        self.pad_id = self.eos_id  # GPT-2 has no pad token
+        self.unk_id = self.eos_id
+        self._cache: dict[str, tuple[str, ...]] = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def _bpe(self, token: str) -> tuple[str, ...]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        # Bound the cache: high-cardinality traffic (UUIDs, hashes) in a
+        # long-lived server must not grow RSS without limit.
+        if len(self._cache) >= 65536:
+            self._cache.clear()
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, 1 << 60))
+            if best not in self.ranks:
+                break
+            a, b = best
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str, max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        ids: list[int] = []
+        for tok in self.pat.findall(text):
+            mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
+            for piece in self._bpe(mapped):
+                ids.append(self.vocab.get(piece, self.unk_id))
+                if len(ids) >= max_len:
+                    break
+            if len(ids) >= max_len:
+                break
+        n = len(ids)
+        out = np.full((max_len,), self.pad_id, np.int32)
+        out[:n] = ids
+        mask = np.zeros((max_len,), np.int32)
+        mask[:n] = 1
+        return out, mask
+
+    def decode(self, ids) -> str:
+        chars: list[str] = []
+        for i in ids:
+            i = int(i)
+            if i == self.eos_id:
+                break
+            tok = self.inv_vocab.get(i)
+            if tok is not None:
+                chars.append(tok)
+        data = bytes(self.byte_dec.get(c, 32) for c in "".join(chars))
+        return data.decode("utf-8", errors="replace")
+
+
 def build_tokenizer(tokenizer_path: str | None, for_t5: bool = False):
     """Tokenizer factory honoring TOKENIZER_PATH with byte-level fallback.
 
     File-format routing: ``spiece.model`` / ``*.tsv`` / ``*.vocab`` →
-    SentencePiece unigram (the T5 family's real tokenizer); anything
-    else → WordPiece ``vocab.txt`` (BERT family).  ``for_t5`` only
-    shapes the no-asset byte fallback and SP eos behavior.
+    SentencePiece unigram (the T5 family's real tokenizer);
+    ``vocab.json`` (+ sibling ``merges.txt``) → GPT-2 byte-level BPE;
+    anything else → WordPiece ``vocab.txt`` (BERT family).  ``for_t5``
+    only shapes the no-asset byte fallback and SP eos behavior.
     """
     if tokenizer_path:
         if tokenizer_path.endswith((".model", ".tsv", ".vocab")):
             from .sentencepiece import load_sentencepiece
 
             return load_sentencepiece(tokenizer_path, add_eos=for_t5)
+        if tokenizer_path.endswith(".json"):
+            return ByteLevelBPETokenizer(tokenizer_path)
         return WordPieceTokenizer(tokenizer_path)
     return ByteTokenizer(add_cls_sep=not for_t5, add_eos=for_t5)

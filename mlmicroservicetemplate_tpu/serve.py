@@ -22,7 +22,7 @@ def parse_args(argv: list[str] | None = None) -> dict:
     p = argparse.ArgumentParser(description="TPU-native inference microservice")
     p.add_argument(
         "--model", dest="MODEL_NAME",
-        help="resnet50 | bert-base | bert-long | t5-small",
+        help="resnet50 | bert-base | bert-long | t5-small | gpt2",
     )
     p.add_argument("--device", dest="DEVICE", help="tpu | cpu")
     p.add_argument("--host", dest="HOST")
