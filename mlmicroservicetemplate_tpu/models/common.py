@@ -71,8 +71,17 @@ def dense_init(key, d_in: int, d_out: int, bias: bool = True, std: float | None 
     return p
 
 
+def maybe_dequant(w, dtype) -> jax.Array:
+    """Transparent int8 weight-only dequant (see ``models/quant.py``):
+    a quantized leaf is {"q8", "scale"}; the convert+multiply fuses
+    into the consuming matmul's operand load under XLA."""
+    if isinstance(w, dict) and "q8" in w:
+        return w["q8"].astype(dtype) * w["scale"].astype(dtype)
+    return w.astype(dtype)
+
+
 def dense(p: Params, x: jax.Array) -> jax.Array:
-    y = x @ p["kernel"].astype(x.dtype)
+    y = x @ maybe_dequant(p["kernel"], x.dtype)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
@@ -86,7 +95,7 @@ def conv2d(p: Params, x: jax.Array, stride: int = 1, padding="SAME") -> jax.Arra
     """NHWC conv with HWIO kernel — the MXU-friendly layout."""
     return lax.conv_general_dilated(
         x,
-        p["kernel"].astype(x.dtype),
+        maybe_dequant(p["kernel"], x.dtype),
         window_strides=(stride, stride),
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -147,6 +156,13 @@ def embedding_init(key, vocab: int, d: int, std: float = 0.02):
 
 def embed(p: Params, ids: jax.Array, dtype=None) -> jax.Array:
     t = p["embedding"]
+    if isinstance(t, dict) and "q8" in t:
+        # Per-ROW scales: gather rows + their scales, dequant only what
+        # the lookup touches (never the whole table).
+        rows = jnp.take(t["q8"], ids, axis=0)
+        scales = jnp.take(t["scale"], ids, axis=0)
+        out_dtype = dtype if dtype is not None else jnp.float32
+        return rows.astype(out_dtype) * scales.astype(out_dtype)
     if dtype is not None:
         t = t.astype(dtype)
     return jnp.take(t, ids, axis=0)

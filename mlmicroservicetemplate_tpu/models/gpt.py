@@ -107,8 +107,10 @@ def _qkv(p, cfg: GPTConfig, x):
 
 def _logits(params: Params, cfg: GPTConfig, x) -> jax.Array:
     """Tied LM head; logits in f32 for exact argmax."""
-    w = params["wte"]["embedding"]
-    return x.astype(jnp.float32) @ w.astype(jnp.float32).T
+    from .common import maybe_dequant
+
+    w = maybe_dequant(params["wte"]["embedding"], jnp.float32)
+    return x.astype(jnp.float32) @ w.T
 
 
 # ---------------------------------------------------------------------------

@@ -128,6 +128,18 @@ def _load_or_init(name: str, model_path: str | None, init_fn, converter):
     return init_fn(jax.random.PRNGKey(0))
 
 
+
+def _maybe_quantize(params, svc_cfg):
+    """Apply QUANTIZE=int8 weight-only quantization after dtype cast
+    (scales stay f32; see models/quant.py)."""
+    mode = getattr(svc_cfg, "quantize", None)
+    if not mode:
+        return params
+    from .quant import quantize_pytree
+
+    return quantize_pytree(params, mode)
+
+
 def _build_resnet(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     from ..convert import resnet_state_to_pytree
     from .common import cast_pytree
@@ -137,6 +149,7 @@ def _build_resnet(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                            functools.partial(resnet_mod.init_params, cfg=cfg),
                            resnet_state_to_pytree)
     params = cast_pytree(params, policy.param_jnp)
+    params = _maybe_quantize(params, svc_cfg)
 
     def forward(p, images):
         # images arrive uint8; normalize on device, then cast for the MXU.
@@ -165,6 +178,7 @@ def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                            functools.partial(bert_mod.init_params, cfg=cfg),
                            bert_state_to_pytree)
     params = cast_pytree(params, policy.param_jnp)
+    params = _maybe_quantize(params, svc_cfg)
 
     # Decide the Pallas fused-attention path once, at serving-build
     # time: inference-only call site, so the kernel's lack of VJP and
@@ -226,6 +240,7 @@ def _build_bert_long(svc_cfg, policy: DtypePolicy) -> ModelBundle:
             "or lower the buckets"
         )
     params = cast_pytree(params, policy.param_jnp)
+    params = _maybe_quantize(params, svc_cfg)
 
     mesh = make_sp_mesh(getattr(svc_cfg, "sp", 0))
     width = mesh.devices.size
@@ -264,6 +279,7 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                            functools.partial(t5_mod.init_params, cfg=cfg),
                            t5_state_to_pytree)
     params = cast_pytree(params, policy.param_jnp)
+    params = _maybe_quantize(params, svc_cfg)
 
     # Same serving-only Pallas opt-in as BERT (the kernel has no VJP;
     # the rel-pos bias rides into the fused kernel as a [1,H,S,S] block).
@@ -319,6 +335,7 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                            functools.partial(gpt_mod.init_params, cfg=cfg),
                            gpt2_state_to_pytree)
     params = cast_pytree(params, policy.param_jnp)
+    params = _maybe_quantize(params, svc_cfg)
 
     # Decode positions run to prompt_len + max_decode_len; jnp.take
     # CLAMPS past the wpe table (silently wrong logits), so (a) the

@@ -285,9 +285,14 @@ def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[Dec
     x = rmsnorm(params["decoder"]["final_ln"], x)
     # Tied lm_head with T5's d_model**-0.5 output scale; logits in f32.
     x = x * (cfg.d_model**-0.5)
+    from .common import maybe_dequant
+
     lm = params.get("lm_head", params["shared"])
-    w = lm["kernel"] if "kernel" in lm else lm["embedding"].T
-    logits = (x[:, 0].astype(jnp.float32)) @ w.astype(jnp.float32)
+    if "kernel" in lm:
+        w = maybe_dequant(lm["kernel"], jnp.float32)
+    else:
+        w = maybe_dequant(lm["embedding"], jnp.float32).T
+    logits = (x[:, 0].astype(jnp.float32)) @ w
 
     next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)

@@ -87,8 +87,24 @@ class ServiceConfig(BaseModel):
     # service; 0 disables (register-once, template-parity behavior).
     register_heartbeat_s: float = 0.0
 
+    # Weight-only quantization for serving: None (full precision) or
+    # "int8" (per-channel symmetric; halves weight bytes per decode
+    # step — the lever for HBM-bound small-batch generation).
+    quantize: str | None = None
+
     # Observability.
     log_level: str = "INFO"
+
+    @field_validator("quantize")
+    @classmethod
+    def _check_quantize(cls, v: str | None) -> str | None:
+        if v is not None:
+            v = v.lower()
+            if v in ("", "none", "0", "false"):
+                return None
+            if v != "int8":
+                raise ValueError(f"QUANTIZE must be 'int8' or unset, got {v!r}")
+        return v
 
     @field_validator("device")
     @classmethod
@@ -137,6 +153,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "host": "HOST",
         "server_url": "SERVER_URL",
         "log_level": "LOG_LEVEL",
+        "quantize": "QUANTIZE",
     }
     for field, var in mapping.items():
         v = get(var)

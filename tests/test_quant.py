@@ -1,0 +1,125 @@
+"""Weight-only int8 quantization tests: reconstruction bounds, dequant-
+aware primitives, model-level accuracy, config/serving integration."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mlmicroservicetemplate_tpu.models import bert as bert_mod
+from mlmicroservicetemplate_tpu.models import gpt as gpt_mod
+from mlmicroservicetemplate_tpu.models.common import embed, dense, maybe_dequant
+from mlmicroservicetemplate_tpu.models.quant import (
+    MIN_QUANT_SIZE,
+    quant_error_stats,
+    quantize_pytree,
+)
+
+from helpers import TINY_BERT
+
+
+def test_reconstruction_error_bounded():
+    """Symmetric per-channel int8: |w - q*scale| <= scale/2 per column."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((128, 64)) * 0.3, jnp.float32)
+    q = quantize_pytree({"kernel": w}, "int8")["kernel"]
+    assert q["q8"].dtype == jnp.int8
+    stats = quant_error_stats(w, q)
+    max_scale = float(np.asarray(q["scale"]).max())
+    assert stats["max"] <= max_scale / 2 + 1e-6
+
+
+def test_small_and_1d_params_untouched():
+    params = {
+        "ln": {"scale": jnp.ones((768,))},          # rank 1 — skip
+        "tiny": {"kernel": jnp.ones((4, 4))},       # < MIN_QUANT_SIZE — skip
+        "big": {"kernel": jnp.ones((128, 64))},     # quantized
+    }
+    assert 128 * 64 >= MIN_QUANT_SIZE
+    out = quantize_pytree(params, "int8")
+    assert not isinstance(out["ln"]["scale"], dict)
+    assert not isinstance(out["tiny"]["kernel"], dict)
+    assert "q8" in out["big"]["kernel"]
+
+
+def test_embed_dequantizes_per_row():
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.standard_normal((512, 32)), jnp.float32)
+    q = quantize_pytree({"embedding": table}, "int8")
+    ids = jnp.asarray([0, 7, 511, 7])
+    got = embed(q, ids, jnp.float32)
+    full = maybe_dequant(q["embedding"], jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full)[np.asarray(ids)], rtol=1e-6)
+    # and the reconstruction is close to the original rows
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(table)[np.asarray(ids)], atol=0.02
+    )
+
+
+def test_dense_with_quantized_kernel():
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.standard_normal((64, 128)) * 0.1, jnp.float32)
+    b = jnp.zeros((128,))
+    x = jnp.asarray(rng.standard_normal((3, 64)), jnp.float32)
+    ref = dense({"kernel": w, "bias": b}, x)
+    qp = quantize_pytree({"kernel": w, "bias": b}, "int8")
+    got = dense(qp, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.05)
+
+
+def test_bert_quantized_logits_close():
+    cfg = TINY_BERT(hidden_size=64, intermediate_size=128)
+    params = bert_mod.init_params(jax.random.PRNGKey(0), cfg=cfg)
+    ids = np.ones((2, 16), np.int32)
+    ids[1, :8] = 9
+    mask = np.ones((2, 16), np.int32)
+    ref = np.asarray(bert_mod.classify(params, cfg, ids, mask))
+    qparams = quantize_pytree(params, "int8")
+    got = np.asarray(bert_mod.classify(qparams, cfg, ids, mask))
+    assert np.argmax(got, -1).tolist() == np.argmax(ref, -1).tolist()
+    np.testing.assert_allclose(got, ref, atol=0.25)
+
+
+def test_gpt_quantized_generation_runs_and_tracks():
+    cfg = gpt_mod.GPTConfig(
+        vocab_size=211, d_model=64, num_heads=2, num_layers=2, d_ff=128,
+        max_position=64, eos_id=1, pad_id=0,
+    )
+    params = gpt_mod.init_params(jax.random.PRNGKey(1), cfg)
+    ids = np.arange(5, 13, dtype=np.int32)[None]
+    mask = np.ones((1, 8), np.int32)
+    ref = np.asarray(gpt_mod.lm_logits(params, cfg, ids, mask))
+    qparams = quantize_pytree(params, "int8")
+    got = np.asarray(gpt_mod.lm_logits(qparams, cfg, ids, mask))
+    # logits track closely; greedy argmax at the generation position agrees
+    np.testing.assert_allclose(got, ref, atol=0.5, rtol=0.1)
+    assert int(np.argmax(got[0, -1])) == int(np.argmax(ref[0, -1]))
+    gen = np.asarray(gpt_mod.greedy_generate(qparams, cfg, ids, mask, 6))
+    assert gen.shape == (1, 6)
+
+
+def test_config_and_serving_integration():
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig, load_config
+
+    cfg = load_config({"QUANTIZE": "int8", "DEVICE": "cpu"})
+    assert cfg.quantize == "int8"
+    assert load_config({"QUANTIZE": "none", "DEVICE": "cpu"}).quantize is None
+    with pytest.raises(Exception):
+        ServiceConfig(device="cpu", quantize="int4")
+
+    # Full registry build with quantization + one engine dispatch.
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+
+    svc = ServiceConfig(
+        device="cpu", model_name="bert-base", warmup=False, quantize="int8",
+        batch_buckets=(1,), seq_buckets=(32,),
+    )
+    bundle = build_model(svc)
+    assert "q8" in bundle.params["embeddings"]["word"]["embedding"]
+    eng = InferenceEngine(bundle, svc, ReplicaSet(make_mesh(1)))
+    feats = {"input_ids": np.ones(8, np.int32), "length": np.int32(8)}
+    row = eng.run_batch([feats])[0]
+    assert row.shape == (2,) and np.all(np.isfinite(row))
