@@ -90,7 +90,8 @@ async def _on_startup(app: web.Application) -> None:
 async def _canary(app: web.Application) -> None:
     bundle = app["bundle"]
     if bundle.kind == "image_classification":
-        feats = {"image": np.zeros((bundle.image_size, bundle.image_size, 3), np.float32)}
+        # uint8 like every real image path (the pipeline's wire dtype).
+        feats = {"image": np.zeros((bundle.image_size, bundle.image_size, 3), np.uint8)}
     else:
         feats = {"input_ids": np.ones(8, np.int32), "length": np.int32(8)}
     await app["batcher"].submit(feats)
@@ -173,6 +174,9 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
 
     try:
         row = await app["batcher"].submit(feats)
+        # Postprocess sits inside the same try: EVERY terminal status on
+        # /predict increments REQUESTS, including a postprocess crash.
+        result = await loop.run_in_executor(None, bundle.postprocess, row)
     except QueueFullError:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
         raise web.HTTPServiceUnavailable(reason="batch queue full, retry later")
@@ -182,7 +186,6 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
         metrics.REQUESTS.labels(bundle.name, "500").inc()
         log.exception("inference dispatch failed")
         raise web.HTTPInternalServerError(reason="inference failed")
-    result = await loop.run_in_executor(None, bundle.postprocess, row)
     dt = time.monotonic() - t0
     result["model"] = bundle.name
     result["timing_ms"] = round(dt * 1000.0, 3)

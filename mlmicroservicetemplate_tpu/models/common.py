@@ -80,6 +80,34 @@ def maybe_dequant(w, dtype) -> jax.Array:
     return w.astype(dtype)
 
 
+def lm_head_logits(x: jax.Array, w, transposed: bool = False) -> jax.Array:
+    """f32 logits for a (possibly int8-quantized) LM head.
+
+    For a quantized weight the per-output-channel scale factors out of
+    the matmul *exactly* — logits = (x @ q8) * scale — so the int8
+    table is never dequantized in full.  The naive
+    ``x @ maybe_dequant(w).T`` materializes a full-precision copy of
+    the largest tensor on the decode path (e.g. GPT-2's [50257, 768]
+    wte), which XLA hoists out of the decode scan as loop-invariant,
+    negating the int8 HBM saving; this form keeps only the int8 bytes
+    resident.
+
+    ``transposed=True`` means ``w`` is an embedding table [V, D] (tied
+    head, per-ROW scales); otherwise a kernel [D, V] (per-column).
+    """
+    if isinstance(w, dict) and "q8" in w:
+        q8 = w["q8"]
+        scale = w["scale"].astype(jnp.float32)
+        xf = x.astype(jnp.float32)
+        if transposed:  # [V, D] table, scale [V, 1] -> one scale per logit
+            return (xf @ q8.T.astype(jnp.float32)) * scale[:, 0][None, :]
+        return (xf @ q8.astype(jnp.float32)) * scale  # scale [1, V]
+    wf = w.astype(jnp.float32)
+    if transposed:
+        wf = wf.T
+    return x.astype(jnp.float32) @ wf
+
+
 def dense(p: Params, x: jax.Array) -> jax.Array:
     y = x @ maybe_dequant(p["kernel"], x.dtype)
     if "bias" in p:

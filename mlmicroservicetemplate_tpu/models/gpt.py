@@ -106,11 +106,12 @@ def _qkv(p, cfg: GPTConfig, x):
 
 
 def _logits(params: Params, cfg: GPTConfig, x) -> jax.Array:
-    """Tied LM head; logits in f32 for exact argmax."""
-    from .common import maybe_dequant
+    """Tied LM head; logits in f32 for exact argmax.  Quantized tables
+    go through the scale-factored matmul (``common.lm_head_logits``) so
+    no full-precision copy of wte is ever materialized in the scan."""
+    from .common import lm_head_logits
 
-    w = maybe_dequant(params["wte"]["embedding"], jnp.float32)
-    return x.astype(jnp.float32) @ w.T
+    return lm_head_logits(x, params["wte"]["embedding"], transposed=True)
 
 
 # ---------------------------------------------------------------------------

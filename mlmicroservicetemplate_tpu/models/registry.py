@@ -182,10 +182,11 @@ def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 
     # Decide the Pallas fused-attention path once, at serving-build
     # time: inference-only call site, so the kernel's lack of VJP and
-    # sharding rules never leaks into training/tp consumers.
+    # sharding rules never leaks into training/tp consumers.  The max
+    # seq bucket gates the default (single-block VMEM regime).
     from ..ops.attention import use_pallas_attention
 
-    use_pallas = use_pallas_attention()
+    use_pallas = use_pallas_attention(max_seq=max(svc_cfg.seq_buckets))
 
     def forward(p, input_ids, attention_mask):
         return bert_mod.classify(
@@ -285,7 +286,7 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     # the rel-pos bias rides into the fused kernel as a [1,H,S,S] block).
     from ..ops.attention import use_pallas_attention
 
-    use_pallas = use_pallas_attention()
+    use_pallas = use_pallas_attention(max_seq=max(svc_cfg.seq_buckets))
 
     def encode_fn(p, input_ids, attention_mask):
         return t5_mod.encode(
@@ -331,6 +332,24 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     cfg = gpt_mod.GPTConfig(
         eos_id=int(tokenizer.eos_id), pad_id=int(tokenizer.pad_id)
     )
+    # A tokenizer that can emit ids past the checkpoint's embedding
+    # table would hit jnp.take's silent clamp (confidently wrong
+    # logits, no error) — same failure class as bert-long's position
+    # table.  Compare the MAX emittable id, not the vocab count: a
+    # sparse/edited vocab.json can have ids far past len(vocab).
+    max_id = int(getattr(tokenizer, "max_token_id",
+                         getattr(tokenizer, "vocab_size", 1) - 1))
+    if max_id >= cfg.vocab_size:
+        raise ValueError(
+            f"tokenizer at {svc_cfg.tokenizer_path!r} can emit id "
+            f"{max_id} >= gpt2 embedding table rows {cfg.vocab_size}; "
+            "out-of-range ids would be silently clamped"
+        )
+    if not (0 <= cfg.eos_id < cfg.vocab_size and 0 <= cfg.pad_id < cfg.vocab_size):
+        raise ValueError(
+            f"tokenizer eos_id={cfg.eos_id}/pad_id={cfg.pad_id} outside "
+            f"gpt2 vocab of {cfg.vocab_size}"
+        )
     params = _load_or_init("gpt2", svc_cfg.model_path,
                            functools.partial(gpt_mod.init_params, cfg=cfg),
                            gpt2_state_to_pytree)

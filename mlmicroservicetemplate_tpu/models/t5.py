@@ -284,15 +284,16 @@ def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[Dec
 
     x = rmsnorm(params["decoder"]["final_ln"], x)
     # Tied lm_head with T5's d_model**-0.5 output scale; logits in f32.
+    # Quantized heads use the scale-factored matmul (no full-precision
+    # copy of the table inside the decode scan — common.lm_head_logits).
     x = x * (cfg.d_model**-0.5)
-    from .common import maybe_dequant
+    from .common import lm_head_logits
 
     lm = params.get("lm_head", params["shared"])
     if "kernel" in lm:
-        w = maybe_dequant(lm["kernel"], jnp.float32)
+        logits = lm_head_logits(x[:, 0], lm["kernel"], transposed=False)
     else:
-        w = maybe_dequant(lm["embedding"], jnp.float32).T
-    logits = (x[:, 0].astype(jnp.float32)) @ w
+        logits = lm_head_logits(x[:, 0], lm["embedding"], transposed=True)
 
     next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)

@@ -163,6 +163,13 @@ class WordPieceTokenizer:
         mask[:n] = 1
         return out, mask
 
+    # Spacing heuristics for detokenization (WordPiece has no offsets,
+    # so original whitespace is unrecoverable; these render natural
+    # text instead of "don ' t"-style surfaces).
+    _GLUE_BOTH = set("'’-/")  # joins to neighbors on both sides
+    _NO_SPACE_BEFORE = set(".,!?;:%)]}\"") | _GLUE_BOTH
+    _NO_SPACE_AFTER = set("([{$#'’")
+
     def decode(self, ids) -> str:
         toks = []
         for i in ids:
@@ -176,7 +183,15 @@ class WordPieceTokenizer:
                 toks[-1] += t[2:]
             else:
                 toks.append(t)
-        return " ".join(toks)
+        text = ""
+        glue = True  # no leading space
+        for t in toks:
+            if glue or (len(t) == 1 and t in self._NO_SPACE_BEFORE):
+                text += t
+            else:
+                text += " " + t
+            glue = len(t) == 1 and (t in self._GLUE_BOTH or t in self._NO_SPACE_AFTER)
+        return text
 
 
 @_functools.lru_cache(maxsize=1)
@@ -229,12 +244,18 @@ class ByteLevelBPETokenizer:
         )
         self.eos_id = self.vocab.get("<|endoftext|>", len(self.vocab) - 1)
         self.pad_id = self.eos_id  # GPT-2 has no pad token
-        self.unk_id = self.eos_id
         self._cache: dict[str, tuple[str, ...]] = {}
 
     @property
     def vocab_size(self) -> int:
         return len(self.vocab)
+
+    @property
+    def max_token_id(self) -> int:
+        """Largest id this tokenizer can emit — what embedding-table
+        bounds checks must compare against (a sparse/edited vocab.json
+        can have ids far past len(vocab))."""
+        return max(self.vocab.values()) if self.vocab else 0
 
     def _bpe(self, token: str) -> tuple[str, ...]:
         cached = self._cache.get(token)
@@ -269,7 +290,14 @@ class ByteLevelBPETokenizer:
         for tok in self.pat.findall(text):
             mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
             for piece in self._bpe(mapped):
-                ids.append(self.vocab.get(piece, self.unk_id))
+                piece_id = self.vocab.get(piece)
+                if piece_id is None:
+                    # A full vocab.json covers every single byte, so
+                    # this only fires on truncated vocabs.  Emitting
+                    # eos here (GPT-2 has no unk) would semantically
+                    # truncate the prompt mid-text — skip instead.
+                    continue
+                ids.append(piece_id)
                 if len(ids) >= max_len:
                     break
             if len(ids) >= max_len:

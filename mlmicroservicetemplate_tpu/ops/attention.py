@@ -26,7 +26,14 @@ import jax
 import jax.numpy as jnp
 
 
-def use_pallas_attention() -> bool:
+# The kernel materializes full [S, S] f32 scores (plus [S, S] bias for
+# T5) in VMEM per grid step — the single-block regime.  Past this
+# sequence length the block no longer fits and compiles would fail at
+# warmup, so default-on falls back to the jnp path instead.
+PALLAS_SINGLE_BLOCK_MAX_SEQ = 512
+
+
+def use_pallas_attention(max_seq: int | None = None) -> bool:
     """Default ON for TPU serving; USE_PALLAS_ATTENTION=0 disables.
 
     Measured wins (benchmarks/pallas_ab.py, v5e, device time isolated
@@ -34,14 +41,25 @@ def use_pallas_attention() -> bool:
     S=512 2.10x.  The kernel is verified against the jnp path at every
     serving seq bucket (32..512) in bf16 on real hardware.  Serving
     call sites only — no VJP, so training/tp consumers stay on jnp.
+
+    ``max_seq`` is the largest configured seq bucket: beyond
+    ``PALLAS_SINGLE_BLOCK_MAX_SEQ`` (single-block VMEM regime) the
+    default flips off so raising SEQ_BUCKETS never turns into a
+    VMEM-overflow compile failure at warmup.  USE_PALLAS_ATTENTION=1
+    forces the kernel on regardless (operator overrides the guard).
     """
     env = os.environ.get("USE_PALLAS_ATTENTION", "").lower()
     if env in ("0", "false", "no"):
         return False
     try:
-        return jax.default_backend() == "tpu"
+        on_tpu = jax.default_backend() == "tpu"
     except Exception:
         return False
+    if env in ("1", "true", "yes"):
+        return on_tpu
+    if max_seq is not None and max_seq > PALLAS_SINGLE_BLOCK_MAX_SEQ:
+        return False
+    return on_tpu
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float):

@@ -168,6 +168,9 @@ class Batcher:
             first = await self._queue.get()
             if first is _END:
                 return
+            # Keep the depth gauge honest on drain: pulling the last
+            # queued item must drop it to 0 now, not at the next submit.
+            metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
             batch = [first]
             deadline = time.monotonic() + self.timeout_s
             while len(batch) < self.max_batch:

@@ -57,7 +57,6 @@ class ServiceConfig(BaseModel):
     # requests are padded up to the nearest bucket (SURVEY.md §7.4.1).
     batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32)
     seq_buckets: tuple[int, ...] = (32, 64, 128, 256, 512)
-    max_seq_len: int = 512
     # Warm (AOT-compile) every bucket at startup so compilation never
     # lands on the request path. Disable for fast test startup.
     warmup: bool = True
@@ -132,9 +131,10 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
 
     Recognized variables (reference-parity names first):
       DEVICE, MODEL_NAME, MODEL_PATH, TOKENIZER_PATH, HOST, PORT,
-      MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, MAX_SEQ_LEN,
+      MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, SP,
       MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
-      MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS.
+      MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS, QUANTIZE,
+      REGISTER_HEARTBEAT_S.
     """
     e = dict(os.environ)
     if env:
@@ -165,7 +165,6 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "max_queue": "MAX_QUEUE",
         "replicas": "REPLICAS",
         "sp": "SP",
-        "max_seq_len": "MAX_SEQ_LEN",
         "max_decode_len": "MAX_DECODE_LEN",
         "pipeline_depth": "PIPELINE_DEPTH",
         "max_streams": "MAX_STREAMS",

@@ -206,3 +206,29 @@ def test_bpe_tokenizer_roundtrip(tmp_path):
     # The "he" merge actually fires.
     ids, tmask = tok.encode("he", 8)
     assert int(tmask.sum()) == 1 and int(ids[0]) == vocab[hl]
+
+
+def test_gpt2_registry_rejects_oversized_tokenizer_vocab(tmp_path):
+    """A tokenizer that can emit ids past the embedding table must fail
+    at build time (jnp.take would silently clamp them otherwise)."""
+    import json
+
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+    from mlmicroservicetemplate_tpu.models.tokenizer import _bytes_to_unicode
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    b2u = _bytes_to_unicode()
+    toks = [b2u[b] for b in range(256)]
+    # Pad the vocab past GPT-2's 50257 rows.
+    vocab = {t: i for i, t in enumerate(toks)}
+    for i in range(len(toks), 50300):
+        vocab[f"<extra{i}>"] = i
+    vocab["<|endoftext|>"] = 50300
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (tmp_path / "merges.txt").write_text("#version: 0.2\n", encoding="utf-8")
+    with pytest.raises(ValueError, match="silently clamped"):
+        build_model(ServiceConfig(
+            device="cpu", model_name="gpt2", warmup=False,
+            seq_buckets=(64,), max_decode_len=16,
+            tokenizer_path=str(tmp_path / "vocab.json"),
+        ))

@@ -95,3 +95,11 @@ def test_pallas_flag_requires_tpu_and_honors_disable(monkeypatch):
     assert ops_attn.use_pallas_attention() is True
     monkeypatch.setenv("USE_PALLAS_ATTENTION", "0")
     assert ops_attn.use_pallas_attention() is False
+    # Seq buckets beyond the single-block VMEM regime flip the default
+    # OFF (no warmup-time VMEM-overflow compiles) — but an explicit
+    # USE_PALLAS_ATTENTION=1 overrides the guard.
+    monkeypatch.delenv("USE_PALLAS_ATTENTION", raising=False)
+    assert ops_attn.use_pallas_attention(max_seq=512) is True
+    assert ops_attn.use_pallas_attention(max_seq=2048) is False
+    monkeypatch.setenv("USE_PALLAS_ATTENTION", "1")
+    assert ops_attn.use_pallas_attention(max_seq=2048) is True

@@ -44,7 +44,8 @@ def test_wordpiece(tmp_path):
     n = int(mask.sum())
     toks = [tok.inv_vocab[i] for i in ids[:n]]
     assert toks == ["[CLS]", "the", "quick", "brown", "fox", "jump", "##ed", "!", "[SEP]"]
-    assert tok.decode(ids[:n]) == "the quick brown fox jumped !"
+    # Detokenizer spacing: punctuation attaches to the preceding word.
+    assert tok.decode(ids[:n]) == "the quick brown fox jumped!"
 
 
 def test_wordpiece_unk(tmp_path):
@@ -66,3 +67,41 @@ def test_factory_fallback():
     assert ids[n - 1] == t5_tok.eos_id
     # T5 byte fallback ids stay inside the t5-small vocab space.
     assert ids.max() < 32128
+
+
+def test_wordpiece_decode_contractions(tmp_path):
+    """Apostrophes/hyphens glue both sides: no "don ' t" surfaces."""
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "don", "'", "t", "say",
+             ",", "ok", "well", "-", "known"]
+    vp = tmp_path / "vocab.txt"
+    vp.write_text("\n".join(vocab))
+    tok = WordPieceTokenizer(str(vp))
+    ids, mask = tok.encode("don't say, ok", max_len=16)
+    n = int(mask.sum())
+    assert tok.decode(ids[:n]) == "don't say, ok"
+    ids, mask = tok.encode("well-known", max_len=16)
+    n = int(mask.sum())
+    assert tok.decode(ids[:n]) == "well-known"
+
+
+def test_bpe_oov_piece_skipped_not_eos(tmp_path):
+    """A vocab.json missing a byte char must NOT inject eos (which would
+    semantically truncate a GPT-2 prompt) — the piece is skipped."""
+    import json
+
+    from mlmicroservicetemplate_tpu.models.tokenizer import (
+        ByteLevelBPETokenizer,
+        _bytes_to_unicode,
+    )
+
+    b2u = _bytes_to_unicode()
+    # Vocab covers a/b/space but NOT 'z'; no merges.
+    keep = [b2u[ord(c)] for c in "ab "]
+    vocab = {t: i for i, t in enumerate(keep + ["<|endoftext|>"])}
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (tmp_path / "merges.txt").write_text("#version: 0.2\n", encoding="utf-8")
+    tok = ByteLevelBPETokenizer(str(tmp_path / "vocab.json"))
+    ids, mask = tok.encode("azb", 16)
+    n = int(mask.sum())
+    assert tok.eos_id not in ids[:n].tolist()
+    assert tok.decode(ids[:n]) == "ab"
