@@ -64,6 +64,9 @@ async def _on_startup(app: web.Application) -> None:
                 app["state"]["warmup_s"] = await loop.run_in_executor(
                     None, engine.warmup
                 )
+                # Continuous-batching executables (slot insert, batched
+                # chunk) compile in the same not-ready window.
+                await loop.run_in_executor(None, batcher.warmup)
             else:
                 # Canary dispatch: readiness means "the device answers",
                 # not just "the process is up".
@@ -126,7 +129,27 @@ async def _parse_request(request: web.Request) -> RawItem:
         if not isinstance(text, str) or not text:
             raise web.HTTPBadRequest(reason='JSON body needs a non-empty "text" field')
         stream = bool(body.get("stream", False))
-        return RawItem(text=text, stream=stream)
+        # Sampling controls (generative models; greedy when absent).
+        try:
+            temperature = float(body.get("temperature", 0.0))
+            top_k = int(body.get("top_k", 0))
+            top_p = float(body.get("top_p", 1.0))
+            seed = body.get("seed")
+            seed = int(seed) if seed is not None else None
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(
+                reason="temperature/top_p must be numbers, top_k/seed integers"
+            )
+        if temperature < 0 or not (0.0 < top_p <= 1.0) or top_k < 0:
+            raise web.HTTPBadRequest(
+                reason="need temperature >= 0, 0 < top_p <= 1, top_k >= 0"
+            )
+        if seed is not None and not (0 <= seed < 2**32):
+            raise web.HTTPBadRequest(reason="seed must be in [0, 2**32)")
+        return RawItem(
+            text=text, stream=stream, temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed,
+        )
     if ctype.startswith("multipart/"):
         reader = await request.multipart()
         async for part in reader:

@@ -77,29 +77,36 @@ class InferenceEngine:
         )
 
         if bundle.kind == KIND_SEQ2SEQ:
-            self._gen_chunk = jax.jit(bundle.generate_chunk_fn, static_argnums=2)
+            # static: n_steps, sample-path flag; donated: the decode
+            # state (every caller reassigns it, and donation keeps the
+            # big KV buffers in place across chunk dispatches).
+            self._gen_chunk = jax.jit(
+                bundle.generate_chunk_fn, static_argnums=(2, 3), donate_argnums=(1,)
+            )
 
             # encode + cache init + first decode chunk fused into ONE
             # executable: time-to-first-token pays a single device
             # round-trip instead of three (encode / init / chunk each
-            # cost a full relay RTT otherwise).
-            def start(p, ids, mask, max_len: int, n_steps: int):
+            # cost a full relay RTT otherwise).  ``sp`` is the per-row
+            # SampleParams pytree; ``sample`` statically picks the
+            # argmax fast path vs the sampling path.
+            def start(p, ids, mask, sp, max_len: int, n_steps: int, sample: bool):
                 enc = bundle.encode_fn(p, ids, mask)
-                state = bundle.init_state_fn(p, enc, mask, max_len)
-                return bundle.generate_chunk_fn(p, state, n_steps)
+                state = bundle.init_state_fn(p, enc, mask, max_len, sample=sp)
+                return bundle.generate_chunk_fn(p, state, n_steps, sample)
 
-            self._start = jax.jit(start, static_argnums=(3, 4))
+            self._start = jax.jit(start, static_argnums=(4, 5, 6))
 
             # Non-streaming generate: encode + init + a done-aware
             # while_loop of chunk scans, still ONE dispatch.  An
             # all-EOS batch exits at the next chunk boundary instead of
             # paying the full max_decode_len scan on the device.
-            def full(p, ids, mask, max_len: int, chunk: int):
+            def full(p, ids, mask, sp, max_len: int, chunk: int, sample: bool):
                 import jax.numpy as jnp
                 from jax import lax
 
                 enc = bundle.encode_fn(p, ids, mask)
-                state = bundle.init_state_fn(p, enc, mask, max_len)
+                state = bundle.init_state_fn(p, enc, mask, max_len, sample=sp)
                 # Bucket-padding rows (all-zero mask) never emit EOS, so
                 # they must count as done from the start or the early
                 # exit could never fire on any padded batch.
@@ -108,16 +115,18 @@ class InferenceEngine:
                 def cond(s):
                     import jax.numpy as jnp
 
-                    return jnp.logical_and(s.pos < max_len, ~s.done.all())
+                    # pos is per-row; all rows start together here, so
+                    # any() == lockstep progress.
+                    return jnp.logical_and((s.pos < max_len).any(), ~s.done.all())
 
                 def body(s):
-                    s, _ = bundle.generate_chunk_fn(p, s, chunk)
+                    s, _ = bundle.generate_chunk_fn(p, s, chunk, sample)
                     return s
 
                 state = lax.while_loop(cond, body, state)
-                return state.tokens, state.pos
+                return state.tokens, state.pos.max()
 
-            self._full = jax.jit(full, static_argnums=(3, 4))
+            self._full = jax.jit(full, static_argnums=(4, 5, 6))
         else:
             self._forward = jax.jit(bundle.forward)
         # Decode steps actually executed by the most recent non-streaming
@@ -156,6 +165,37 @@ class InferenceEngine:
             mask[i, :L] = 1
         return ids, mask, n
 
+    def _collate_sample(self, feats: list[dict], bsz: int):
+        """Per-row SampleParams from request fields; bucket-pad rows are
+        greedy.  Returns (SampleParams, sampled) — ``sampled`` picks the
+        statically-compiled sampling executable only when some row
+        actually needs it (the argmax path never pays the per-step
+        [B, V] sort)."""
+        import random
+
+        from ..models.sampling import make_params
+
+        temp = np.zeros(bsz, np.float32)
+        top_k = np.zeros(bsz, np.int32)
+        top_p = np.ones(bsz, np.float32)
+        seed = np.zeros(bsz, np.uint32)
+        sampled = False
+        for i, f in enumerate(feats):
+            t = float(f.get("temperature", 0.0))
+            temp[i] = t
+            if t > 0.0:
+                sampled = True
+                top_k[i] = int(f.get("top_k", 0))
+                top_p[i] = float(f.get("top_p", 1.0))
+                s = f.get("seed")
+                # Unseeded sampled requests must differ from each other.
+                # Mask defensively: np.uint32() raises OverflowError on
+                # out-of-range ints (numpy 2.x), and one bad row must
+                # not fail a shared batch.
+                s = int(s) if s is not None else random.getrandbits(32)
+                seed[i] = np.uint32(s & 0xFFFFFFFF)
+        return make_params(seed, temp, top_k, top_p), sampled
+
     # ------------------------------------------------------------------
     # dispatch
 
@@ -186,9 +226,11 @@ class InferenceEngine:
             else:  # seq2seq, non-streaming: ONE dispatch for encode +
                 # init + done-aware chunked decode (early EOS exit)
                 ids, mask, n = self._collate_text(feats)
+                sp, sampled = self._collate_sample(feats, ids.shape[0])
                 ids, mask = self.replicas.place_batch(ids, mask)
                 tokens, steps = self._full(
-                    self.params, ids, mask, self.max_decode_len, self.chunk_tokens
+                    self.params, ids, mask, sp,
+                    self.max_decode_len, self.chunk_tokens, sampled,
                 )
                 # tokens + step count in ONE transfer (each device_get
                 # pays a full relay round-trip).
@@ -211,10 +253,12 @@ class InferenceEngine:
             raise ValueError(f"{self.bundle.name} does not support streaming")
         with self._lock:
             ids, mask, _ = self._collate_text([feats])
+            sp, sampled = self._collate_sample([feats], ids.shape[0])
             ids, mask = self.replicas.place_batch(ids, mask)
             # First chunk fused with encode+init: TTFT = one round-trip.
             state, toks = self._start(
-                self.params, ids, mask, self.max_decode_len, self.chunk_tokens
+                self.params, ids, mask, sp,
+                self.max_decode_len, self.chunk_tokens, sampled,
             )
             # One transfer for tokens+done — each device_get pays a full
             # relay round-trip, so never fetch them separately.
@@ -226,7 +270,9 @@ class InferenceEngine:
             return
         while produced < self.max_decode_len:
             with self._lock:
-                state, toks = self._gen_chunk(self.params, state, self.chunk_tokens)
+                state, toks = self._gen_chunk(
+                    self.params, state, self.chunk_tokens, sampled
+                )
                 toks_np, done_np = jax.device_get((toks, state.done))
                 chunk, done = toks_np[0], bool(done_np[0])
             produced += self.chunk_tokens
@@ -276,12 +322,14 @@ class InferenceEngine:
                 feats = {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
                 with self._lock:
                     ids, mask, _ = self._collate_text([feats])
+                    sp, _ = self._collate_sample([feats], ids.shape[0])
                     ids, mask = self.replicas.place_batch(ids, mask)
                     state, _ = self._start(
-                        self.params, ids, mask, self.max_decode_len, self.chunk_tokens
+                        self.params, ids, mask, sp,
+                        self.max_decode_len, self.chunk_tokens, False,
                     )
                     state, toks = self._gen_chunk(
-                        self.params, state, self.chunk_tokens
+                        self.params, state, self.chunk_tokens, False
                     )
                     jax.device_get(toks)
         dt = time.monotonic() - t0

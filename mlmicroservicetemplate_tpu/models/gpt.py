@@ -158,16 +158,23 @@ def lm_logits(
 
 
 class GPTState(NamedTuple):
-    """Static-shape decode state; caches span prompt + decode budget."""
+    """Static-shape decode state; caches span prompt + decode budget.
+
+    EVERY field is per-row (leading dim B): rows decode independently,
+    which is what lets a continuous-batching loop insert a freshly
+    prefilled request into slot i while other rows are mid-generation
+    (``engine/streams.py``).
+    """
 
     cache_k: Any  # per layer [B, S+Tmax, H, D]
     cache_v: Any
     key_valid: jax.Array  # [B, S+Tmax] int32 — 1 where cache rows are real
     write_idx: jax.Array  # [B] int32 — position the NEXT step processes
-    pos: jax.Array  # [] int32 — decode steps taken (engine contract)
+    pos: jax.Array  # [B] int32 — decode steps taken per row
     last_token: jax.Array  # [B] int32 — token the next step embeds
     done: jax.Array  # [B] bool
     tokens: jax.Array  # [B, Tmax] generated tokens (pad-filled)
+    sample: Any  # sampling.SampleParams, all [B]-shaped
 
 
 def init_decode_state(
@@ -177,7 +184,10 @@ def init_decode_state(
     attention_mask: jax.Array,  # [B, S]
     max_len: int,
     dtype=jnp.float32,
+    sample=None,  # SampleParams [B] or None (greedy)
 ) -> GPTState:
+    from .sampling import greedy_params
+
     b, s = input_ids.shape
     total = s + max_len
     _, kv = forward_hidden(
@@ -202,29 +212,33 @@ def init_decode_state(
         cache_v=cache_v,
         key_valid=key_valid,
         write_idx=jnp.maximum(lengths - 1, 0),
-        pos=jnp.int32(0),
+        pos=jnp.zeros((b,), jnp.int32),
         last_token=last_tok.astype(jnp.int32),
         done=lengths == 0,  # fully-pad rows never generate
         tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+        sample=sample if sample is not None else greedy_params(b),
     )
 
 
-def _decode_step(params: Params, cfg: GPTConfig, state: GPTState):
+def _decode_step(params: Params, cfg: GPTConfig, state: GPTState, sample: bool = False):
     dtype = state.cache_k[0].dtype
     b = state.last_token.shape[0]
     rows = jnp.arange(b)
     t = state.write_idx  # [B] per-row position
     x = embed(params["wte"], state.last_token[:, None], dtype)  # [B,1,D]
-    x = x + embed(params["wpe"], t, dtype)[:, None]
-    key_valid = state.key_valid.at[rows, t].set(1)
+    # Long-dead rows (continuous batching: slot freed, not yet reused)
+    # keep stepping; clamp their position lookup and DROP their writes
+    # so they never corrupt in-range cache entries.
+    x = x + embed(params["wpe"], jnp.minimum(t, cfg.max_position - 1), dtype)[:, None]
+    key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
     attn_mask = (key_valid != 0)[:, None, None, :]  # [B,1,1,total]
 
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
         q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B,1,H,D]
-        ck = state.cache_k[li].at[rows, t].set(k1[:, 0])
-        cv = state.cache_v[li].at[rows, t].set(v1[:, 0])
+        ck = state.cache_k[li].at[rows, t].set(k1[:, 0], mode="drop")
+        cv = state.cache_v[li].at[rows, t].set(v1[:, 0], mode="drop")
         new_k.append(ck)
         new_v.append(cv)
         ctx = mha_attention(q, ck, cv, mask=attn_mask)
@@ -234,12 +248,15 @@ def _decode_step(params: Params, cfg: GPTConfig, state: GPTState):
     x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
     logits = _logits(params, cfg, x[:, 0])  # [B, V]
 
-    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if sample:
+        from .sampling import select_token
+
+        next_tok, sp = select_token(logits, state.sample)
+    else:
+        next_tok, sp = jnp.argmax(logits, axis=-1).astype(jnp.int32), state.sample
     next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
     done = state.done | (next_tok == cfg.eos_id)
-    tokens = jax.lax.dynamic_update_slice_in_dim(
-        state.tokens, next_tok[:, None], state.pos, axis=1
-    )
+    tokens = state.tokens.at[rows, state.pos].set(next_tok, mode="drop")
     new_state = GPTState(
         cache_k=new_k,
         cache_v=new_v,
@@ -249,18 +266,21 @@ def _decode_step(params: Params, cfg: GPTConfig, state: GPTState):
         last_token=next_tok,
         done=done,
         tokens=tokens,
+        sample=sp,
     )
     return new_state, next_tok
 
 
 def generate_chunk(
-    params: Params, cfg: GPTConfig, state: GPTState, n_steps: int
+    params: Params, cfg: GPTConfig, state: GPTState, n_steps: int, sample: bool = False
 ) -> tuple[GPTState, jax.Array]:
-    """``n_steps`` greedy decode steps in one compiled scan; returns
-    (state, [B, n_steps] tokens) — the engine's chunk contract."""
+    """``n_steps`` decode steps in one compiled scan; returns
+    (state, [B, n_steps] tokens) — the engine's chunk contract.
+    ``sample`` is STATIC: False compiles the argmax fast path (no
+    [B, V] sort per step), True the per-row sampling path."""
 
     def step(s, _):
-        return _decode_step(params, cfg, s)
+        return _decode_step(params, cfg, s, sample)
 
     state, toks = jax.lax.scan(step, state, None, length=n_steps)
     return state, jnp.transpose(toks)

@@ -73,7 +73,14 @@ class ModelBundle:
             max_len = self.cfg.max_position if hasattr(self.cfg, "max_position") else 512
         ids, mask = self.tokenizer.encode(item.text, max_len)
         n = int(mask.sum())
-        return {"input_ids": ids[:n], "length": np.int32(n)}
+        feats = {"input_ids": ids[:n], "length": np.int32(n)}
+        if self.kind == KIND_SEQ2SEQ and item.temperature > 0.0:
+            feats["temperature"] = float(item.temperature)
+            feats["top_k"] = int(item.top_k)
+            feats["top_p"] = float(item.top_p)
+            if item.seed is not None:
+                feats["seed"] = int(item.seed)
+        return feats
 
     def postprocess(self, row: np.ndarray) -> dict:
         if self.kind == KIND_IMAGE:
@@ -104,11 +111,19 @@ class ModelBundle:
 
 @dataclasses.dataclass
 class RawItem:
-    """One unparsed /predict payload."""
+    """One unparsed /predict payload.
+
+    Sampling knobs apply to generative (seq2seq/causal-LM) models only;
+    temperature 0 = greedy (the default).  Unseeded sampled requests
+    draw a fresh seed per request."""
 
     text: str | None = None
     image: bytes | None = None
     stream: bool = False
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -294,11 +309,11 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
             dtype=policy.compute_jnp, use_pallas=use_pallas,
         )
 
-    def init_state_fn(p, enc_out, enc_mask, max_len: int):
-        return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len)
+    def init_state_fn(p, enc_out, enc_mask, max_len: int, sample=None):
+        return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len, sample=sample)
 
-    def generate_chunk_fn(p, state, n_steps: int):
-        return t5_mod.generate_chunk(p, cfg, state, n_steps)
+    def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
+        return t5_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
     return ModelBundle(
         name="t5-small",
@@ -383,13 +398,14 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         # init_state_fn — both live inside the same fused jit dispatch.
         return input_ids
 
-    def init_state_fn(p, input_ids, enc_mask, max_len: int):
+    def init_state_fn(p, input_ids, enc_mask, max_len: int, sample=None):
         return gpt_mod.init_decode_state(
-            p, cfg, input_ids, enc_mask, max_len, dtype=policy.compute_jnp
+            p, cfg, input_ids, enc_mask, max_len, dtype=policy.compute_jnp,
+            sample=sample,
         )
 
-    def generate_chunk_fn(p, state, n_steps: int):
-        return gpt_mod.generate_chunk(p, cfg, state, n_steps)
+    def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
+        return gpt_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
     return ModelBundle(
         name="gpt2",

@@ -155,6 +155,20 @@ def _position_bias(
     return jnp.transpose(bias, (2, 0, 1))[None]
 
 
+def _position_bias_rows(
+    rel_bias: Params,
+    cfg: T5Config,
+    t: jax.Array,  # [B] int32 — per-row decode position
+    k_pos: jax.Array,  # [Sk] int32
+) -> jax.Array:
+    """[B, H, 1, Sk] causal decode bias where every row sits at its OWN
+    position (continuous batching serves rows at different depths)."""
+    rel = k_pos[None, :] - t[:, None]  # [B, Sk]
+    buckets = _relative_bucket(rel, False, cfg.rel_buckets, cfg.rel_max_distance)
+    bias = embed(rel_bias, buckets)  # [B, Sk, H]
+    return jnp.transpose(bias, (0, 2, 1))[:, :, None, :]
+
+
 # ---------------------------------------------------------------------------
 # blocks
 
@@ -203,17 +217,24 @@ def encode(
 
 
 class DecodeState(NamedTuple):
-    """Static-shape incremental decode state (everything lives on device)."""
+    """Static-shape incremental decode state (everything lives on device).
+
+    EVERY field is per-row (leading dim B) — rows decode independently
+    at their own positions, which is what lets the continuous-batching
+    loop (``engine/streams.py``) insert a freshly prefilled request
+    into one slot while other rows are mid-generation.
+    """
 
     cache_k: Any  # list of [B, Tmax, H, D] per decoder layer
     cache_v: Any
     cross_k: Any  # list of [B, Senc, H, D] — precomputed once
     cross_v: Any
     enc_mask: jax.Array  # [B, Senc]
-    pos: jax.Array  # [] int32 — next position to write
+    pos: jax.Array  # [B] int32 — next position to write, per row
     last_token: jax.Array  # [B] int32
     done: jax.Array  # [B] bool
     tokens: jax.Array  # [B, Tmax] int32 — generated so far (pad-filled)
+    sample: Any  # sampling.SampleParams, all [B]-shaped
 
 
 def init_decode_state(
@@ -222,7 +243,10 @@ def init_decode_state(
     enc_out: jax.Array,  # [B, Senc, D]
     enc_mask: jax.Array,  # [B, Senc]
     max_len: int,
+    sample=None,  # SampleParams [B] or None (greedy)
 ) -> DecodeState:
+    from .sampling import greedy_params
+
     b = enc_out.shape[0]
     dtype = enc_out.dtype
     cache_k, cache_v, cross_k, cross_v = [], [], [], []
@@ -238,24 +262,30 @@ def init_decode_state(
         cross_k=cross_k,
         cross_v=cross_v,
         enc_mask=enc_mask,
-        pos=jnp.int32(0),
+        pos=jnp.zeros((b,), jnp.int32),
         last_token=jnp.full((b,), cfg.decoder_start_id, jnp.int32),
         done=jnp.zeros((b,), bool),
         tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+        sample=sample if sample is not None else greedy_params(b),
     )
 
 
-def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[DecodeState, jax.Array]:
-    """One greedy decode step; returns (new_state, emitted token [B])."""
+def _decode_step(
+    params: Params, cfg: T5Config, state: DecodeState, sample: bool = False
+) -> tuple[DecodeState, jax.Array]:
+    """One decode step (argmax or per-row sampling); returns
+    (new_state, emitted token [B]).  All position logic is per-row."""
     dtype = state.cross_k[0].dtype
     max_len = state.tokens.shape[1]
+    b = state.last_token.shape[0]
+    rows = jnp.arange(b)
     x = embed(params["shared"], state.last_token[:, None], dtype)  # [B,1,D]
-    t = state.pos
+    t = state.pos  # [B]
     k_pos = jnp.arange(max_len, dtype=jnp.int32)
-    # Causal-with-cache mask: attend to positions <= t.
-    self_mask = (k_pos <= t)[None, None, None, :]
+    # Causal-with-cache mask: each row attends to positions <= its t.
+    self_mask = (k_pos[None, :] <= t[:, None])[:, None, None, :]  # [B,1,1,T]
     rel = params["decoder"]["layers"][0]["self_attn"]["rel_bias"]
-    self_bias = _position_bias(rel, cfg, t[None], k_pos, bidirectional=False)
+    self_bias = _position_bias_rows(rel, cfg, t, k_pos)  # [B,H,1,T]
     cross_mask = state.enc_mask[:, None, None, :].astype(bool)
 
     new_k, new_v = [], []
@@ -265,8 +295,10 @@ def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[Dec
         q = split_heads(dense(sa["q"], h), cfg.num_heads)  # [B,1,H,D]
         k1 = split_heads(dense(sa["k"], h), cfg.num_heads)
         v1 = split_heads(dense(sa["v"], h), cfg.num_heads)
-        ck = lax.dynamic_update_slice_in_dim(state.cache_k[li], k1, t, axis=1)
-        cv = lax.dynamic_update_slice_in_dim(state.cache_v[li], v1, t, axis=1)
+        # Per-row scatter; DROP out-of-range writes (a freed slot in the
+        # continuous loop keeps stepping past the budget harmlessly).
+        ck = state.cache_k[li].at[rows, t].set(k1[:, 0], mode="drop")
+        cv = state.cache_v[li].at[rows, t].set(v1[:, 0], mode="drop")
         new_k.append(ck)
         new_v.append(cv)
         ctx = mha_attention(q, ck, cv, mask=self_mask, bias=self_bias, scale=1.0)
@@ -295,12 +327,15 @@ def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[Dec
     else:
         logits = lm_head_logits(x[:, 0], lm["embedding"], transposed=True)
 
-    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if sample:
+        from .sampling import select_token
+
+        next_tok, sp = select_token(logits, state.sample)
+    else:
+        next_tok, sp = jnp.argmax(logits, axis=-1).astype(jnp.int32), state.sample
     next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
     done = state.done | (next_tok == cfg.eos_id)
-    tokens = lax.dynamic_update_slice_in_dim(
-        state.tokens, next_tok[:, None], t, axis=1
-    )
+    tokens = state.tokens.at[rows, t].set(next_tok, mode="drop")
     new_state = DecodeState(
         cache_k=new_k,
         cache_v=new_v,
@@ -311,21 +346,24 @@ def _decode_step(params: Params, cfg: T5Config, state: DecodeState) -> tuple[Dec
         last_token=next_tok,
         done=done,
         tokens=tokens,
+        sample=sp,
     )
     return new_state, next_tok
 
 
 def generate_chunk(
-    params: Params, cfg: T5Config, state: DecodeState, n_steps: int
+    params: Params, cfg: T5Config, state: DecodeState, n_steps: int, sample: bool = False
 ) -> tuple[DecodeState, jax.Array]:
-    """Run ``n_steps`` greedy decode steps in ONE compiled scan.
+    """Run ``n_steps`` decode steps in ONE compiled scan.
 
     Returns (state, chunk_tokens [B, n_steps]). The engine jits this per
     chunk size; streaming granularity = n_steps tokens per dispatch.
+    ``sample`` is STATIC: False = argmax fast path, True = per-row
+    temperature/top-k/top-p sampling (models/sampling.py).
     """
 
     def step(s, _):
-        s, tok = _decode_step(params, cfg, s)
+        s, tok = _decode_step(params, cfg, s, sample)
         return s, tok
 
     state, toks = lax.scan(step, state, None, length=n_steps)

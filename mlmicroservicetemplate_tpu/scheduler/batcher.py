@@ -62,6 +62,17 @@ class Batcher:
         self._task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
         self._closed = False
+        # Continuous batching (default): concurrent generative streams
+        # share ONE batched decode dispatch instead of holding a worker
+        # each (engine/streams.py).  CONTINUOUS_BATCHING=0 falls back to
+        # the per-stream path above (kept for A/B measurement).
+        self._cdl = None
+        if getattr(engine.bundle, "kind", None) == "seq2seq" and getattr(
+            cfg, "continuous_batching", True
+        ):
+            from ..engine.streams import ContinuousDecodeLoop
+
+            self._cdl = ContinuousDecodeLoop(engine, cfg)
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -76,8 +87,17 @@ class Batcher:
             self._task = None
         if self._inflight:
             await asyncio.gather(*self._inflight, return_exceptions=True)
+        if self._cdl is not None:
+            await asyncio.get_running_loop().run_in_executor(None, self._cdl.stop)
         self._executor.shutdown(wait=False)
         self._stream_executor.shutdown(wait=False)
+
+    def warmup(self) -> None:
+        """Blocking: compile the continuous-batching executables (slot
+        insert, batched chunk) so the first stream pays no compiles.
+        Called from the app's warmup executor, after engine.warmup."""
+        if self._cdl is not None:
+            self._cdl.warm()
 
     # ------------------------------------------------------------------
     async def submit(self, feats: dict) -> np.ndarray:
@@ -106,7 +126,14 @@ class Batcher:
         half-consumed) generator cannot leak a slot."""
         if self._closed:
             raise RuntimeError("batcher is stopped")
-        if self._active_streams >= self.max_streams:
+        if self._cdl is not None and int(feats.get("length", 0)) <= self._cdl.max_prompt:
+            return self._cdl.submit_stream(feats)
+        # Oversized prompts (longer than the largest seq bucket) cannot
+        # join the shared slot batch; they keep the per-stream path —
+        # but MAX_STREAMS caps TOTAL concurrent generations, so count
+        # the loop's admissions too.
+        cdl_active = self._cdl._admitted if self._cdl is not None else 0
+        if self._active_streams + cdl_active >= self.max_streams:
             raise QueueFullError(
                 f"{self._active_streams} streams active >= max_streams={self.max_streams}"
             )

@@ -72,9 +72,12 @@ class ServiceConfig(BaseModel):
     # Seq2seq decoding (T5).
     max_decode_len: int = 64
     stream_chunk_tokens: int = 4
-    # Concurrent streaming generations admitted before 503 shedding
-    # (each stream holds a dedicated worker for its full generation).
+    # Concurrent streaming generations admitted before 503 shedding.
     max_streams: int = 8
+    # Continuous batching: live streams share one batched decode
+    # dispatch, new streams admitted at chunk boundaries
+    # (engine/streams.py).  Off = round-2 per-stream workers.
+    continuous_batching: bool = True
 
     # Parent orchestration-server registration (template parity:
     # the public template self-registers with a Photo Analysis Server on
@@ -134,7 +137,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, SP,
       MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
       MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS, QUANTIZE,
-      REGISTER_HEARTBEAT_S.
+      REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING.
     """
     e = dict(os.environ)
     if env:
@@ -191,4 +194,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("WARMUP")
     if v is not None:
         kwargs["warmup"] = v.lower() not in ("0", "false", "no")
+    v = get("CONTINUOUS_BATCHING")
+    if v is not None:
+        kwargs["continuous_batching"] = v.lower() not in ("0", "false", "no")
     return ServiceConfig(**kwargs)

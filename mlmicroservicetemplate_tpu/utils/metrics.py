@@ -73,6 +73,11 @@ BATCH_SIZE = Histogram(
 )
 QUEUE_DEPTH = Gauge("batch_queue_depth", "Requests currently queued", ["model"])
 TOKENS = Counter("generated_tokens_total", "Seq2seq tokens generated", ["model"])
+STREAM_BATCH = Histogram(
+    "stream_batch_size",
+    "Live streams served per continuous-batching chunk dispatch",
+    ["model"], buckets=(1, 2, 4, 8, 16, 32),
+)
 DECODE_STEPS = Histogram(
     "seq2seq_decode_steps",
     "Decode steps executed per non-streaming seq2seq dispatch "

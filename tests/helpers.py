@@ -110,11 +110,11 @@ def tiny_t5_bundle(seed: int = 0) -> ModelBundle:
     def encode_fn(p, input_ids, attention_mask):
         return t5_mod.encode(p, cfg, input_ids, attention_mask, dtype=policy.compute_jnp)
 
-    def init_state_fn(p, enc_out, enc_mask, max_len: int):
-        return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len)
+    def init_state_fn(p, enc_out, enc_mask, max_len: int, sample=None):
+        return t5_mod.init_decode_state(p, cfg, enc_out, enc_mask, max_len, sample=sample)
 
-    def generate_chunk_fn(p, state, n_steps: int):
-        return t5_mod.generate_chunk(p, cfg, state, n_steps)
+    def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
+        return t5_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
     return ModelBundle(
         name="t5-small", kind=KIND_SEQ2SEQ, cfg=cfg, params=params, policy=policy,

@@ -197,7 +197,12 @@ def _serve(bundle_fn, main, **cfg_kw):
 def test_client_disconnect_midstream_releases_slot():
     """A client that drops mid-stream must not keep burning device
     dispatches: the stream slot frees and chunk dispatch stops at the
-    next boundary (VERDICT weak #6)."""
+    next boundary (VERDICT weak #6).  Pinned to the legacy per-stream
+    path (continuous_batching=False) — it instruments
+    engine.generate_stream, which the continuous loop never calls; the
+    loop's own disconnect behavior is covered by
+    tests/test_streams.py::test_cancel_frees_slot and the HTTP-level
+    test below."""
 
     async def main(client, engine, batcher, app):
         calls = {"n": 0}
@@ -228,7 +233,67 @@ def test_client_disconnect_midstream_releases_slot():
         # Far fewer chunks dispatched than the full decode budget.
         assert calls["n"] < 16
 
-    _serve(tiny_t5_bundle, main, max_decode_len=64, stream_chunk_tokens=4)
+    _serve(tiny_t5_bundle, main, max_decode_len=64, stream_chunk_tokens=4,
+           continuous_batching=False)
+
+
+def test_disconnect_midstream_frees_continuous_slot():
+    """Same disconnect scenario on the DEFAULT (continuous-batching)
+    path: the admission counter returns to 0 so new streams are not
+    shed."""
+
+    async def main(client, engine, batcher, app):
+        assert batcher._cdl is not None
+        resp = await client.post(
+            "/predict", json={"text": "summarize: disconnect me", "stream": True}
+        )
+        assert resp.status == 200
+        await resp.content.readline()
+        resp.close()  # hard client disconnect
+        for _ in range(300):
+            if batcher._cdl._admitted == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert batcher._cdl._admitted == 0
+        # Slot is reusable: a fresh stream completes.
+        resp = await client.post(
+            "/predict", json={"text": "summarize: again", "stream": True}
+        )
+        assert resp.status == 200
+        lines = (await resp.text()).strip().splitlines()
+        assert json.loads(lines[-1]).get("done") is True
+
+    _serve(tiny_t5_bundle, main, max_decode_len=32, stream_chunk_tokens=4,
+           max_streams=1)
+
+
+def test_predict_sampling_fields():
+    """temperature/top_k/top_p/seed accepted and validated; seeded
+    sampled responses reproduce exactly."""
+
+    async def body(client):
+        payload = {"text": "summarize: hello there", "temperature": 0.9,
+                   "top_p": 0.95, "seed": 7}
+        r1 = await client.post("/predict", json=payload)
+        assert r1.status == 200
+        r2 = await client.post("/predict", json=payload)
+        out1, out2 = await r1.json(), await r2.json()
+        assert out1["prediction"]["text"] == out2["prediction"]["text"]
+        # Validation: bad ranges are 400s, counted like other parse 400s.
+        bad = await client.post(
+            "/predict", json={"text": "x", "temperature": -1}
+        )
+        assert bad.status == 400
+        bad = await client.post(
+            "/predict", json={"text": "x", "top_p": 0}
+        )
+        assert bad.status == 400
+        bad = await client.post(
+            "/predict", json={"text": "x", "top_k": "many"}
+        )
+        assert bad.status == 400
+
+    _run(tiny_t5_bundle, body)
 
 
 def test_engine_exception_maps_to_500():

@@ -129,11 +129,11 @@ def test_seq2seq_early_exit():
     def encode_fn(p, ids, mask):
         return ids
 
-    def init_state_fn(p, enc, mask, max_len: int):
+    def init_state_fn(p, enc, mask, max_len: int, sample=None):
         b = enc.shape[0]
         return S(jnp.int32(0), jnp.zeros((b,), bool), jnp.zeros((b, max_len), jnp.int32))
 
-    def generate_chunk_fn(p, s, n_steps: int):
+    def generate_chunk_fn(p, s, n_steps: int, sample: bool = False):
         b = s.tokens.shape[0]
         toks = jnp.ones((b, n_steps), jnp.int32)  # EOS-ish: done after chunk 1
         return S(s.pos + n_steps, jnp.ones((b,), bool), s.tokens), toks
@@ -171,11 +171,11 @@ def test_seq2seq_early_exit_with_bucket_padding():
     def encode_fn(p, ids, mask):
         return ids
 
-    def init_state_fn(p, enc, mask, max_len: int):
+    def init_state_fn(p, enc, mask, max_len: int, sample=None):
         b = enc.shape[0]
         return S(jnp.int32(0), jnp.zeros((b,), bool), jnp.zeros((b, max_len), jnp.int32))
 
-    def generate_chunk_fn(p, s, n_steps: int):
+    def generate_chunk_fn(p, s, n_steps: int, sample: bool = False):
         b = s.tokens.shape[0]
         # Only row 0 (the real request) ever reaches EOS.
         done = s.done | (jnp.arange(b) == 0)

@@ -85,11 +85,11 @@ def _tiny_bundle(seed: int = 0) -> ModelBundle:
     def encode_fn(p, input_ids, attention_mask):
         return input_ids
 
-    def init_state_fn(p, input_ids, enc_mask, max_len: int):
-        return gpt_mod.init_decode_state(p, cfg, input_ids, enc_mask, max_len)
+    def init_state_fn(p, input_ids, enc_mask, max_len: int, sample=None):
+        return gpt_mod.init_decode_state(p, cfg, input_ids, enc_mask, max_len, sample=sample)
 
-    def generate_chunk_fn(p, state, n_steps: int):
-        return gpt_mod.generate_chunk(p, cfg, state, n_steps)
+    def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
+        return gpt_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
     return ModelBundle(
         name="gpt2", kind=KIND_SEQ2SEQ, cfg=cfg, params=params, policy=policy,

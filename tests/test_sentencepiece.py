@@ -144,7 +144,7 @@ def test_serving_path_text_fidelity(tmp_path):
     def encode_fn(p, ids, mask):
         return ids
 
-    def init_state_fn(p, src, mask, max_len: int):
+    def init_state_fn(p, src, mask, max_len: int, sample=None):
         b, s = src.shape
         pad_to = max(max_len, s)
         src_padded = jnp.zeros((b, pad_to), jnp.int32).at[:, :s].set(src)
@@ -155,7 +155,7 @@ def test_serving_path_text_fidelity(tmp_path):
             jnp.zeros((b, max_len), jnp.int32),
         )
 
-    def generate_chunk_fn(p, s, n_steps: int):
+    def generate_chunk_fn(p, s, n_steps: int, sample: bool = False):
         # Echo the source ids chunk by chunk (eos included → done).
         idx = s.pos + jnp.arange(n_steps)
         toks = s.src[:, :][:, idx]

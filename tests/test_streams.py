@@ -1,0 +1,338 @@
+"""Continuous-batching decode loop tests (engine/streams.py).
+
+The contract under test:
+1. N concurrent streams produce tokens IDENTICAL to solo runs — rows
+   decode independently at their own positions.
+2. Total chunk dispatches scale with the LONGEST stream, not the
+   stream count (the whole point of sharing one batched dispatch).
+3. Cancelled streams free their slot at the next chunk boundary.
+4. Sampling: seeded streams are deterministic and batch-composition
+   independent; greedy streams stay exact.
+"""
+
+import asyncio
+from typing import NamedTuple
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import text_feats, tiny_t5_bundle
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2, 4, 8))
+    kw.setdefault("seq_buckets", (16, 32))
+    kw.setdefault("max_decode_len", 12)
+    kw.setdefault("stream_chunk_tokens", 4)
+    kw.setdefault("max_streams", 4)
+    return ServiceConfig(**kw)
+
+
+def _echo_bundle():
+    """Per-row echo model: row i re-emits its own prompt ids, then the
+    eos that the T5-style byte tokenizer appended — so every stream's
+    token sequence is a pure function of its prompt, which makes
+    cross-stream routing errors and position drift visible."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mlmicroservicetemplate_tpu.models.registry import KIND_SEQ2SEQ, ModelBundle
+    from mlmicroservicetemplate_tpu.models.sampling import greedy_params
+    from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
+    from mlmicroservicetemplate_tpu.runtime.device import default_policy
+
+    class S(NamedTuple):
+        src: jnp.ndarray  # [B, S]
+        pos: jnp.ndarray  # [B]
+        done: jnp.ndarray  # [B]
+        tokens: jnp.ndarray  # [B, Tmax]
+        sample: object
+
+    def encode_fn(p, ids, mask):
+        return ids
+
+    def init_state_fn(p, src, mask, max_len: int, sample=None):
+        b, s = src.shape
+        return S(
+            src,
+            jnp.zeros((b,), jnp.int32),
+            (mask.sum(axis=-1) == 0),
+            jnp.zeros((b, max_len), jnp.int32),
+            sample if sample is not None else greedy_params(b),
+        )
+
+    def generate_chunk_fn(p, s, n_steps: int, sample: bool = False):
+        def step(st, _):
+            b = st.pos.shape[0]
+            rows = jnp.arange(b)
+            tok = st.src[rows, jnp.minimum(st.pos, st.src.shape[1] - 1)]
+            tok = jnp.where(st.done, jnp.int32(0), tok.astype(jnp.int32))
+            done = st.done | (tok == 1)  # ByteTokenizer eos_id == 1
+            tokens = st.tokens.at[rows, st.pos].set(tok, mode="drop")
+            return S(st.src, st.pos + 1, done, tokens, st.sample), tok
+
+        s, toks = lax.scan(step, s, None, length=n_steps)
+        return s, jnp.transpose(toks)
+
+    return ModelBundle(
+        name="echo", kind=KIND_SEQ2SEQ, cfg=None, params={},
+        policy=default_policy("cpu"),
+        tokenizer=ByteTokenizer(add_eos=True), labels=None, forward=None,
+        encode_fn=encode_fn, init_state_fn=init_state_fn,
+        generate_chunk_fn=generate_chunk_fn,
+    )
+
+
+async def _consume(loop_obj, feats):
+    out = []
+    async for chunk in loop_obj.submit_stream(feats):
+        out.append(np.asarray(chunk))
+    return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+
+async def _collect(gen):
+    out = []
+    async for chunk in gen:
+        out.append(np.asarray(chunk))
+    return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+
+def _run_concurrent(loop_obj, feats_list):
+    async def body():
+        # Submit every stream before consuming any: all of them sit in
+        # pending before the loop thread reaches its first admission
+        # boundary, so one shared batch serves the whole wave.
+        gens = [loop_obj.submit_stream(dict(f)) for f in feats_list]
+        return await asyncio.gather(*[_collect(g) for g in gens])
+
+    return asyncio.run(body())
+
+
+def _solo_tokens(engine, feats):
+    return np.concatenate(list(engine.generate_stream(dict(feats))))
+
+
+def test_concurrent_streams_match_solo_and_share_dispatches():
+    """4 concurrent echo streams: token identity with solo runs AND
+    ~1/N the chunk dispatches of the per-stream design."""
+    bundle = _echo_bundle()
+    cfg = _cfg()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    texts = ["abc", "hello world stream", "xy", "some mid-size text"]
+    feats = [text_feats(bundle.tokenizer, t) for t in texts]
+    solos = [_solo_tokens(eng, f) for f in feats]
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        outs = _run_concurrent(cdl, feats)
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            np.testing.assert_array_equal(got[:n], want[:n])
+            # Streams may differ only in trailing pad-chunk granularity.
+            assert not np.any(want[n:] != 0) and not np.any(got[n:] != 0)
+        # Dispatch economics: 4 streams, budget 12, chunk 4 → solo would
+        # cost 4 streams × 2 follow-up chunks = 8 chunk dispatches; the
+        # shared loop pays at most the longest stream's chunks plus one
+        # admission-staggering chunk per wave.
+        assert cdl.prefill_dispatches == 4
+        assert cdl.chunk_dispatches <= 4, cdl.chunk_dispatches
+    finally:
+        cdl.stop()
+
+
+def test_late_admission_identity():
+    """A stream admitted mid-flight (while another is decoding) still
+    produces its solo tokens — insert into a live batch is exact."""
+    bundle = _echo_bundle()
+    cfg = _cfg(max_decode_len=16)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    f_long = text_feats(bundle.tokenizer, "a fairly long prompt text!")
+    f_late = text_feats(bundle.tokenizer, "late")
+    solo_long = _solo_tokens(eng, f_long)
+    solo_late = _solo_tokens(eng, f_late)
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    async def body():
+        t1 = asyncio.ensure_future(_consume(cdl, dict(f_long)))
+        await asyncio.sleep(0.3)  # let the first stream get admitted
+        t2 = asyncio.ensure_future(_consume(cdl, dict(f_late)))
+        return await asyncio.gather(t1, t2)
+
+    try:
+        got_long, got_late = asyncio.run(body())
+        n = min(len(got_long), len(solo_long))
+        np.testing.assert_array_equal(got_long[:n], solo_long[:n])
+        n = min(len(got_late), len(solo_late))
+        np.testing.assert_array_equal(got_late[:n], solo_late[:n])
+    finally:
+        cdl.stop()
+
+
+def test_cancel_frees_slot():
+    """Breaking out of a stream releases its admission slot so new
+    streams are accepted."""
+    bundle = _echo_bundle()
+    cfg = _cfg(max_streams=1, max_decode_len=32)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(bundle.tokenizer, "spans several chunks")
+
+    async def body():
+        gen = cdl.submit_stream(dict(feats))
+        async for _ in gen:
+            break  # client disconnects after the first chunk
+        await gen.aclose()
+        # The slot must come back (released at a chunk boundary).
+        for _ in range(100):
+            if cdl._admitted == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert cdl._admitted == 0
+        out = await _consume(cdl, dict(feats))
+        assert len(out) > 0
+
+    try:
+        asyncio.run(body())
+    finally:
+        cdl.stop()
+
+
+def test_admission_cap_503():
+    from mlmicroservicetemplate_tpu.scheduler.batcher import QueueFullError
+
+    bundle = _echo_bundle()
+    cfg = _cfg(max_streams=2)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(bundle.tokenizer, "abc")
+
+    async def body():
+        g1 = cdl.submit_stream(dict(feats))
+        g2 = cdl.submit_stream(dict(feats))
+        with pytest.raises(QueueFullError):
+            cdl.submit_stream(dict(feats))
+        # Drain both so stop() is clean.
+        async for _ in g1:
+            pass
+        async for _ in g2:
+            pass
+
+    try:
+        asyncio.run(body())
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# real model: t5
+
+
+def test_t5_concurrent_streams_match_solo():
+    """Three concurrent t5 streams (different prompts/buckets) produce
+    exactly their solo token sequences through the shared batch."""
+    bundle = tiny_t5_bundle()
+    cfg = _cfg(max_decode_len=8, seq_buckets=(16, 32))
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    texts = [
+        "summarize: the quick fox",
+        "translate: hello",
+        "a different prompt here",
+    ]
+    feats = [text_feats(bundle.tokenizer, t) for t in texts]
+    solos = [_solo_tokens(eng, f) for f in feats]
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        outs = _run_concurrent(cdl, feats)
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            np.testing.assert_array_equal(got[:n], want[:n])
+    finally:
+        cdl.stop()
+
+
+def test_t5_sampled_stream_deterministic_under_seed():
+    """temperature>0 + seed: the same request yields the same tokens
+    solo and inside a batch with other (greedy) streams."""
+    bundle = tiny_t5_bundle()
+    cfg = _cfg(max_decode_len=8, seq_buckets=(16, 32))
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    f_sampled = text_feats(bundle.tokenizer, "summarize: the quick brown fox")
+    f_sampled.update(temperature=0.8, top_k=0, top_p=1.0, seed=1234)
+    f_greedy = text_feats(bundle.tokenizer, "another prompt")
+
+    solo1 = _solo_tokens(eng, f_sampled)
+    solo2 = _solo_tokens(eng, f_sampled)
+    np.testing.assert_array_equal(solo1, solo2)
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        outs = _run_concurrent(cdl, [f_sampled, f_greedy])
+        n = min(len(outs[0]), len(solo1))
+        np.testing.assert_array_equal(outs[0][:n], solo1[:n])
+    finally:
+        cdl.stop()
+
+
+def test_t5_sampling_seeds_differ_and_topk1_is_greedy():
+    bundle = tiny_t5_bundle()
+    cfg = _cfg(max_decode_len=8, seq_buckets=(16, 32))
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    base = text_feats(bundle.tokenizer, "summarize: the quick brown fox")
+    greedy = _solo_tokens(eng, dict(base))
+
+    diffs = 0
+    for seed in (7, 8, 9):
+        f = dict(base)
+        f.update(temperature=5.0, top_k=0, top_p=1.0, seed=seed)
+        toks = _solo_tokens(eng, f)
+        n = min(len(toks), len(greedy))
+        if not np.array_equal(toks[:n], greedy[:n]):
+            diffs += 1
+    assert diffs >= 2, "high-temperature sampling should usually diverge"
+
+    f = dict(base)
+    f.update(temperature=1.0, top_k=1, top_p=1.0, seed=42)
+    toks = _solo_tokens(eng, f)
+    n = min(len(toks), len(greedy))
+    np.testing.assert_array_equal(toks[:n], greedy[:n])
+
+
+def test_padded_prefill_does_not_clobber_neighbor_slot():
+    """When the prefill batch is padded past 1 row (batch bucket floor /
+    replica pad multiple), insert must write ONLY row 0 — a full-width
+    write would overwrite the adjacent live stream's state."""
+    bundle = _echo_bundle()
+    # batch bucket floor of 2: every batch=1 prefill is padded to 2 rows.
+    cfg = _cfg(batch_buckets=(2, 4), max_decode_len=16, max_streams=4)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    f_a = text_feats(bundle.tokenizer, "first stream text!")
+    f_b = text_feats(bundle.tokenizer, "second one, later")
+    solo_a = _solo_tokens(eng, f_a)
+    solo_b = _solo_tokens(eng, f_b)
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    async def body():
+        # Stagger so B occupies the slot right after A while A is live.
+        t1 = asyncio.ensure_future(_consume(cdl, dict(f_a)))
+        await asyncio.sleep(0.3)
+        t2 = asyncio.ensure_future(_consume(cdl, dict(f_b)))
+        return await asyncio.gather(t1, t2)
+
+    try:
+        got_a, got_b = asyncio.run(body())
+        n = min(len(got_a), len(solo_a))
+        np.testing.assert_array_equal(got_a[:n], solo_a[:n])
+        n = min(len(got_b), len(solo_b))
+        np.testing.assert_array_equal(got_b[:n], solo_b[:n])
+    finally:
+        cdl.stop()
