@@ -1,9 +1,10 @@
 """Pallas fused-attention A/B: device time with vs without the kernel.
 
-Round-1 verdict: the kernel shipped with no measured win. This measures
-it, isolated from the ~100 ms relay by scanning K forwards inside one
-executable (same method as device_bench.py): wall = K x device_time +
-1 RTT.
+Round-1 verdict: the kernel shipped with no measured win.  This
+measures it with the two-scan-length method (benchmarks/timing.py):
+scans of K and 2K forwards inside one executable are differenced, so
+the per-dispatch relay round-trip cancels exactly — the round-2 weak
+#1 (subtracting a separately-sampled ±10 ms RTT) is gone, and REPS=5.
 
     python benchmarks/pallas_ab.py          # TPU; prints one JSON line
 
@@ -16,50 +17,21 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import numpy as np
 
 SCAN_ITERS = int(os.environ.get("SCAN_ITERS", "8"))
-REPS = 3
-
-
-def _timed_scan(fn, args, rtt: float) -> float:
-    """Median device-seconds per fn() call, via an in-executable scan."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    def scan_k(*xs):
-        def body(carry, _):
-            out = fn(*xs[:-1], xs[-1] + (carry * 0).astype(xs[-1].dtype))
-            return out.astype(jnp.float32).ravel()[0], ()
-
-        carry, _ = lax.scan(body, jnp.float32(0), None, length=SCAN_ITERS)
-        return carry
-
-    jit = jax.jit(scan_k)
-    dev_args = jax.device_put(args)
-    float(jax.device_get(jit(*dev_args)))  # compile
-    times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        float(jax.device_get(jit(*dev_args)))
-        times.append(time.perf_counter() - t0)
-    wall = sorted(times)[len(times) // 2]
-    return max(wall - rtt, 1e-9) / SCAN_ITERS
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from device_bench import measure_rtt
+    from timing import device_time_per_call
     from mlmicroservicetemplate_tpu.models import bert as bert_mod
     from mlmicroservicetemplate_tpu.models import t5 as t5_mod
 
-    rtt = measure_rtt()
-    out: dict = {"rtt_ms": round(rtt * 1000, 1), "scan_iters": SCAN_ITERS}
+    out: dict = {"scan_iters": SCAN_ITERS, "method": "two-scan-length (K vs 2K)"}
 
     # -- BERT-base, B=32, S=512 (the verdict's shape) -------------------
     b, s = 32, 512
@@ -76,8 +48,12 @@ def main() -> None:
             return bert_mod.classify(p, cfg, i, m, dtype=jnp.bfloat16,
                                      use_pallas=use_pallas)
 
-        dt = _timed_scan(fwd, (params, mask, jnp.asarray(ids)), rtt)
+        dt, noisy = device_time_per_call(
+            fwd, (params, mask, jnp.asarray(ids)), iters=SCAN_ITERS
+        )
         out[key] = round(dt * 1000, 3)
+        if noisy:
+            out[key + "_noisy"] = True
 
     out["bert_speedup"] = round(out["bert_xla_ms"] / out["bert_pallas_ms"], 3)
 
@@ -94,8 +70,12 @@ def main() -> None:
             return t5_mod.encode(p, tcfg, i, m, dtype=jnp.bfloat16,
                                  use_pallas=use_pallas)
 
-        dt = _timed_scan(enc, (tparams, t_mask, t_ids), rtt)
+        dt, noisy = device_time_per_call(
+            enc, (tparams, t_mask, t_ids), iters=SCAN_ITERS
+        )
         out[key] = round(dt * 1000, 3)
+        if noisy:
+            out[key + "_noisy"] = True
 
     out["t5_enc_speedup"] = round(out["t5_enc_xla_ms"] / out["t5_enc_pallas_ms"], 3)
     print(json.dumps(out))

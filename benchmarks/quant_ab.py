@@ -1,0 +1,124 @@
+"""QUANTIZE=int8 A/B: measured device-time effect of weight-only int8.
+
+The round-2 verdict: the quant path shipped correctness-tested with an
+HBM-bandwidth rationale and ZERO measured numbers.  This measures the
+claim where it should show — small-batch autoregressive decode is
+weight-streaming-bound, so halving weight bytes should cut per-step
+time — and where it shouldn't (batch-32 encoder forward is
+compute-bound; int8 adds dequant work).
+
+Method: two-scan-length differencing (benchmarks/timing.py) for
+forwards; chunk-length differencing for decode (the chunk IS the scan).
+Both cancel the relay RTT exactly.
+
+    python benchmarks/quant_ab.py            # TPU; one JSON line
+    DEVICE=cpu python benchmarks/quant_ab.py # CPU sanity (slow)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+PROMPT_LEN = int(os.environ.get("BENCH_PROMPT_LEN", "64"))
+DECODE_BATCHES = (1, 8)
+
+
+def _engine(model: str, device: str, quantize: str | None):
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    cfg = ServiceConfig(
+        device=device, model_name=model, warmup=False, quantize=quantize,
+        batch_buckets=(1, 8, 32), seq_buckets=(PROMPT_LEN,),
+        max_decode_len=64,
+    )
+    return InferenceEngine(build_model(cfg), cfg)
+
+
+def _decode_steps(engine, batch: int):
+    import jax
+
+    from timing import chunked_time_per_step
+
+    feats = [{"input_ids": np.ones(PROMPT_LEN, np.int32),
+              "length": np.int32(PROMPT_LEN)}] * batch
+    ids, mask, _ = engine._collate_text(feats)
+    sp, _ = engine._collate_sample(feats, ids.shape[0])
+    ids, mask = engine.replicas.place_batch(ids, mask)
+    state, toks = engine._start(
+        engine.params, ids, mask, sp, engine.max_decode_len,
+        engine.chunk_tokens, False,
+    )
+    jax.device_get(toks)
+    chunk_fn = jax.jit(engine.bundle.generate_chunk_fn, static_argnums=(2, 3))
+
+    def run_chunk(p, s, n):
+        return chunk_fn(p, s, n, False)
+
+    per_step, noisy = chunked_time_per_step(run_chunk, engine.params, state)
+    return per_step, noisy
+
+
+def main() -> None:
+    device = os.environ.get("DEVICE", "tpu")
+    from mlmicroservicetemplate_tpu.runtime.device import apply_device_env
+
+    apply_device_env(device)
+
+    from timing import device_time_per_call
+
+    out: dict = {"device": device, "prompt_len": PROMPT_LEN,
+                 "method": "two-scan-length / chunk-length differencing"}
+
+    # -- gpt2 decode: the HBM-bound case int8 targets -------------------
+    for mode in (None, "int8"):
+        eng = _engine("gpt2", device, mode)
+        key = "bf16" if mode is None else "int8"
+        for b in DECODE_BATCHES:
+            per_step, noisy = _decode_steps(eng, b)
+            row = {
+                "decode_step_ms": round(per_step * 1000, 3),
+                "decode_tokens_s": round(b / per_step, 1),
+            }
+            if noisy:
+                row["timing_noisy"] = True
+            out[f"gpt2_{key}_b{b}"] = row
+        del eng
+    for b in DECODE_BATCHES:
+        out[f"gpt2_int8_speedup_b{b}"] = round(
+            out[f"gpt2_bf16_b{b}"]["decode_step_ms"]
+            / out[f"gpt2_int8_b{b}"]["decode_step_ms"], 3,
+        )
+
+    # -- bert-base forward: compute-bound control ------------------------
+    import jax.numpy as jnp
+
+    for mode in (None, "int8"):
+        eng = _engine("bert-base", device, mode)
+        key = "bf16" if mode is None else "int8"
+        b, s = 32, PROMPT_LEN
+        ids = jnp.asarray(np.ones((b, s), np.int32))
+        mask = jnp.asarray(np.ones((b, s), np.int32))
+        dt, noisy = device_time_per_call(
+            eng.bundle.forward, (eng.params, ids, mask), carry_idx=1
+        )
+        out[f"bert_{key}_batch32_ms"] = round(dt * 1000, 3)
+        if noisy:
+            out[f"bert_{key}_noisy"] = True
+        del eng
+    out["bert_int8_speedup"] = round(
+        out["bert_bf16_batch32_ms"] / out["bert_int8_batch32_ms"], 3
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

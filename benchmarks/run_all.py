@@ -12,6 +12,7 @@ stderr.  ``bench.py`` at the repo root stays the driver-facing headline
   3. ResNet-50 dynamic batching, max_batch -> req/s/chip
   4. BERT-base replica serving             -> req/s over all devices
   5. T5-small streaming seq2seq            -> TTFT, chunks/s
+  6. gpt2 streaming causal-LM              -> TTFT, chunks/s
 """
 
 from __future__ import annotations
@@ -45,7 +46,9 @@ async def main() -> None:
     ) as s:
         r2 = await s.latency(post_text("a short benchmark sentence"))
         rows.append({"config": "bert-base batch=1 latency", **r2})
-        n_dev = s.engine.replicas.n_replicas
+        n_dev = getattr(
+            s.engine.replicas, "n_devices", s.engine.replicas.n_replicas
+        )
         r4 = await s.throughput(post_text("a short benchmark sentence"))
         rows.append(
             {"config": f"bert-base replica serving ({n_dev} device)", **r4}
@@ -62,6 +65,18 @@ async def main() -> None:
     ) as s:
         r5 = await s.stream_stats("summarize: the quick brown fox jumps over the lazy dog")
         rows.append({"config": "t5-small streaming seq2seq", **r5})
+
+    async with ServiceUnderTest(
+        {
+            "MODEL_NAME": "gpt2",
+            "BATCH_BUCKETS": "1,8",
+            "SEQ_BUCKETS": "64",
+            "MAX_DECODE_LEN": "32",
+            **dev,
+        }
+    ) as s:
+        r6 = await s.stream_stats("the quick brown fox jumps over the lazy dog and")
+        rows.append({"config": "gpt2 streaming causal-LM", **r6})
 
     import jax
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import threading
 import time
 from typing import Iterator
@@ -307,12 +308,25 @@ class InferenceEngine:
                     ] * b
                     self.run_batch(feats)
         else:
+            # Sampled executables (static sample=True) are distinct XLA
+            # programs; warm them too or the first temperature>0 request
+            # pays a request-path compile.  WARMUP_SAMPLING=0 skips them
+            # for greedy-only deployments (halves seq2seq warmup).
+            warm_sampled = os.environ.get(
+                "WARMUP_SAMPLING", "1"
+            ).lower() not in ("0", "false", "no")
+            sampled_variants = (False, True) if warm_sampled else (False,)
             for b in batch_buckets:
                 for s in self.seq_buckets:
                     feats = [
                         {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
                     ] * b
                     self.run_batch(feats)
+                    if warm_sampled:
+                        sampled_feats = [
+                            dict(f, temperature=1.0, seed=0) for f in feats
+                        ]
+                        self.run_batch(sampled_feats)
             # The streaming start + follow-up chunk executables compile
             # per encoder seq bucket (KV-cache/cross-attn shapes depend
             # on it).  Warm both DIRECTLY — going through
@@ -320,18 +334,19 @@ class InferenceEngine:
             # the dummy prompt hits EOS inside the first chunk.
             for s in self.seq_buckets:
                 feats = {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
-                with self._lock:
-                    ids, mask, _ = self._collate_text([feats])
-                    sp, _ = self._collate_sample([feats], ids.shape[0])
-                    ids, mask = self.replicas.place_batch(ids, mask)
-                    state, _ = self._start(
-                        self.params, ids, mask, sp,
-                        self.max_decode_len, self.chunk_tokens, False,
-                    )
-                    state, toks = self._gen_chunk(
-                        self.params, state, self.chunk_tokens, False
-                    )
-                    jax.device_get(toks)
+                for flag in sampled_variants:
+                    with self._lock:
+                        ids, mask, _ = self._collate_text([feats])
+                        sp, _ = self._collate_sample([feats], ids.shape[0])
+                        ids, mask = self.replicas.place_batch(ids, mask)
+                        state, _ = self._start(
+                            self.params, ids, mask, sp,
+                            self.max_decode_len, self.chunk_tokens, flag,
+                        )
+                        state, toks = self._gen_chunk(
+                            self.params, state, self.chunk_tokens, flag
+                        )
+                        jax.device_get(toks)
         dt = time.monotonic() - t0
         log.info("warmup compiled %s buckets in %.1fs", self.bundle.name, dt)
         return dt

@@ -102,6 +102,10 @@ class ContinuousDecodeLoop:
         self._state = None  # batched decode state (device), loop-thread-owned
         self._insert = None
         self._admitted = 0  # event-loop-owned admission counter
+        # Streams running OUTSIDE this loop (the Batcher's legacy
+        # per-stream path for oversized prompts) count against the same
+        # MAX_STREAMS total; the Batcher wires this to its own counter.
+        self.external_active = lambda: 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._thread_lock = threading.Lock()
@@ -123,9 +127,10 @@ class ContinuousDecodeLoop:
 
         if self._stop.is_set():
             raise RuntimeError("decode loop is stopped")
-        if self._admitted >= self.max_streams:
+        total = self._admitted + int(self.external_active())
+        if total >= self.max_streams:
             raise QueueFullError(
-                f"{self._admitted} streams active >= max_streams={self.max_streams}"
+                f"{total} streams active >= max_streams={self.max_streams}"
             )
         self._admitted += 1
         st = _Stream(feats, asyncio.get_running_loop())
@@ -268,11 +273,21 @@ class ContinuousDecodeLoop:
         if bool(done_np[0]) or st.produced >= eng.max_decode_len:
             self._finish(st)
             return
-        if self._state is None:
-            self._build_empty_state()
-        slot = self.free.pop()
-        with eng._lock:
-            self._state = self._insert_fn()(self._state, state1, np.int32(slot))
+        # Any failure from here (empty-state build OOM, insert compile)
+        # must terminate THIS consumer and return the slot — the _run
+        # handler only reaches streams already in self.active.
+        slot = None
+        try:
+            if self._state is None:
+                self._build_empty_state()
+            slot = self.free.pop()
+            with eng._lock:
+                self._state = self._insert_fn()(self._state, state1, np.int32(slot))
+        except Exception as e:
+            if slot is not None:
+                self.free.append(slot)
+            self._finish(st, e)
+            return
         self.active[slot] = st
         if sampled:
             self.sampled_slots.add(slot)

@@ -155,6 +155,30 @@ def _maybe_quantize(params, svc_cfg):
     return quantize_pytree(params, mode)
 
 
+def _tp_placement(svc_cfg, model_cfg, family: str):
+    """TP=<n> → a TensorParallelSet factory over a ('replica','tp')
+    mesh with the family's Megatron param spec; None when TP is off.
+
+    Mutually exclusive with QUANTIZE: int8 leaves are {"q8","scale"}
+    dicts the per-leaf PartitionSpec tree cannot describe.
+    """
+    tp = int(getattr(svc_cfg, "tp", 0) or 0)
+    if tp <= 1:
+        return None
+    if getattr(svc_cfg, "quantize", None):
+        raise ValueError(
+            "TP and QUANTIZE cannot combine (quantized leaves are "
+            "{'q8','scale'} subtrees the TP param spec cannot shard); "
+            "pick one"
+        )
+    from ..parallel import TensorParallelSet, make_replica_tp_mesh
+    from ..parallel.tp import PARAM_SPECS
+
+    spec = PARAM_SPECS[family](model_cfg)
+    mesh = make_replica_tp_mesh(tp, int(getattr(svc_cfg, "replicas", 0) or 0))
+    return lambda: TensorParallelSet(mesh, spec)
+
+
 def _build_resnet(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     from ..convert import resnet_state_to_pytree
     from .common import cast_pytree
@@ -195,13 +219,19 @@ def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     params = cast_pytree(params, policy.param_jnp)
     params = _maybe_quantize(params, svc_cfg)
 
+    # TP=<n>: Megatron-shard the params over a ('replica','tp') mesh.
+    make_placement = _tp_placement(svc_cfg, cfg, "bert")
+
     # Decide the Pallas fused-attention path once, at serving-build
     # time: inference-only call site, so the kernel's lack of VJP and
     # sharding rules never leaks into training/tp consumers.  The max
-    # seq bucket gates the default (single-block VMEM regime).
+    # seq bucket gates the default (single-block VMEM regime); TP
+    # forces the jnp path (the kernel has no sharding rules).
     from ..ops.attention import use_pallas_attention
 
-    use_pallas = use_pallas_attention(max_seq=max(svc_cfg.seq_buckets))
+    use_pallas = make_placement is None and use_pallas_attention(
+        max_seq=max(svc_cfg.seq_buckets)
+    )
 
     def forward(p, input_ids, attention_mask):
         return bert_mod.classify(
@@ -218,6 +248,7 @@ def _build_bert(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         tokenizer=build_tokenizer(svc_cfg.tokenizer_path, for_t5=False),
         labels=load_labels(getattr(svc_cfg, "labels_path", None)),
         forward=forward,
+        make_placement=make_placement,
     )
 
 
@@ -258,8 +289,22 @@ def _build_bert_long(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     params = cast_pytree(params, policy.param_jnp)
     params = _maybe_quantize(params, svc_cfg)
 
-    mesh = make_sp_mesh(getattr(svc_cfg, "sp", 0))
-    width = mesh.devices.size
+    # REPLICAS>=2 composes batch DP on top of sequence parallelism:
+    # a ('replica','sp') mesh whose rows are independent ppermute
+    # rings (round-2 verdict: the 1-D sp mesh idled the batch axis).
+    from ..parallel import make_replica_sp_mesh
+
+    replicas = int(getattr(svc_cfg, "replicas", 0) or 0)
+    if replicas > 1:
+        import jax
+
+        sp_width = getattr(svc_cfg, "sp", 0) or max(
+            1, len(jax.devices()) // replicas
+        )
+        mesh = make_replica_sp_mesh(sp_width, replicas)
+    else:
+        mesh = make_sp_mesh(getattr(svc_cfg, "sp", 0))
+    width = int(mesh.shape["sp"])
     bad = [s for s in svc_cfg.seq_buckets if s % width]
     if bad:
         raise ValueError(
@@ -420,6 +465,8 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         init_state_fn=init_state_fn,
         generate_chunk_fn=generate_chunk_fn,
         max_prompt_len=max_prompt,
+        # TP=<n>: decoder Megatron sharding (parallel/tp.py gpt spec).
+        make_placement=_tp_placement(svc_cfg, cfg, "gpt"),
     )
 
 
@@ -466,4 +513,14 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
         raise ValueError(
             f"unknown model {svc_cfg.model_name!r}; available: {sorted(MODEL_REGISTRY)}"
         ) from None
-    return builder(svc_cfg, policy)
+    bundle = builder(svc_cfg, policy)
+    # TP must never be silently ignored: a model deployed BECAUSE
+    # sharding makes it fit would otherwise OOM per-device with no
+    # warning.  (bert-long composes SP, not TP, by design.)
+    if int(getattr(svc_cfg, "tp", 0) or 0) > 1 and bundle.make_placement is None:
+        raise ValueError(
+            f"TP={svc_cfg.tp} is not supported for {svc_cfg.model_name!r} "
+            "(tensor-parallel serving covers bert-base and gpt2; bert-long "
+            "scales via SP/REPLICAS instead)"
+        )
+    return bundle

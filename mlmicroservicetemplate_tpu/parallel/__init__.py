@@ -10,4 +10,12 @@ hand-written communication layer (SURVEY.md §5 "Distributed
 communication backend").
 """
 
-from .mesh import ReplicaSet, SeqParallelSet, make_mesh, make_sp_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    ReplicaSet,
+    SeqParallelSet,
+    TensorParallelSet,
+    make_mesh,
+    make_replica_sp_mesh,
+    make_replica_tp_mesh,
+    make_sp_mesh,
+)

@@ -66,6 +66,12 @@ class ReplicaSet:
 
     @property
     def n_replicas(self) -> int:
+        """Batch data-parallel width (what batch sizes must divide by)."""
+        return self.mesh.devices.size
+
+    @property
+    def n_devices(self) -> int:
+        """Total devices in the serving mesh (all axes)."""
         return self.mesh.devices.size
 
     def place_params(self, params):
@@ -98,25 +104,119 @@ def make_sp_mesh(n_devices: int = 0, devices=None):
     return _make_1d_mesh("sp", n_devices, devices, "SP")
 
 
-class SeqParallelSet(ReplicaSet):
-    """Engine placement for sequence-parallel (long-context) serving.
+def _make_2d_mesh(second_axis: str, width: int, replicas: int = 0, devices=None):
+    """``('replica', <axis>)`` mesh: batch over rows, width over columns.
 
-    Same contract as ``ReplicaSet`` but the SEQUENCE axis (axis 1 of
-    [B, S] batch arrays) is sharded over ``('sp',)`` while the batch
-    axis stays whole on every device — the layout ring attention
-    consumes (``parallel/ring.py``): each device holds its local Q and
-    K/V blocks; K/V blocks rotate over ICI via ppermute.
+    replicas=0 = every remaining visible device (len(devices) // width).
     """
+    import jax
+    from jax.sharding import Mesh
+
+    if width < 1:
+        raise ValueError(f"{second_axis} width must be >= 1, got {width}")
+    devs = list(devices if devices is not None else jax.devices())
+    if replicas == 0:
+        replicas = max(1, len(devs) // width)
+    need = replicas * width
+    if need > len(devs):
+        raise ValueError(
+            f"replicas={replicas} x {second_axis}={width} needs {need} "
+            f"devices, only {len(devs)} visible"
+        )
+    grid = np.array(devs[:need]).reshape(replicas, width)
+    log.info(
+        "('replica', '%s') mesh %dx%d over %d device(s)",
+        second_axis, replicas, width, need,
+    )
+    return Mesh(grid, ("replica", second_axis))
+
+
+def make_replica_tp_mesh(tp: int, replicas: int = 0, devices=None):
+    """``('replica', 'tp')`` serving mesh: Megatron-sharded params over
+    'tp', batch data-parallel over 'replica'."""
+    return _make_2d_mesh("tp", tp, replicas, devices)
+
+
+def make_replica_sp_mesh(sp: int, replicas: int = 0, devices=None):
+    """``('replica', 'sp')`` mesh: long-context ring attention over 'sp'
+    WITH the batch axis data-parallel over 'replica' (round-2 verdict:
+    a 1-D sp mesh left the batch axis idle on every device)."""
+    return _make_2d_mesh("sp", sp, replicas, devices)
+
+
+class TensorParallelSet(ReplicaSet):
+    """Engine placement for tensor-parallel serving.
+
+    Params are sharded per a Megatron-style PartitionSpec pytree
+    (``parallel/tp.py``: column-parallel q/k/v + mlp-up, row-parallel
+    attn-out + mlp-down, vocab-sharded embeddings) over the mesh's
+    'tp' axis; batch arrays shard their leading axis over 'replica'.
+    jit propagates both, and XLA inserts the ICI collectives
+    (all-reduce after row-parallel matmuls) — serving-side Megatron
+    with the compiler owning the comm.
+    """
+
+    def __init__(self, mesh, param_spec):
+        self.param_spec = param_spec
+        super().__init__(mesh)
 
     def _batch_spec(self):
         from jax.sharding import PartitionSpec as P
 
-        return P(None, "sp")
+        return P("replica")
+
+    @property
+    def n_replicas(self) -> int:
+        return int(self.mesh.shape["replica"])
+
+    @property
+    def tp_width(self) -> int:
+        return int(self.mesh.shape["tp"])
+
+    def place_params(self, params):
+        import jax
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            params, self.param_spec,
+        )
 
     def pad_multiple(self) -> int:
-        # Batch sizes need no divisibility; the SEQ bucket must divide
-        # by the mesh width instead.
-        return 1
+        return self.n_replicas
+
+
+class SeqParallelSet(ReplicaSet):
+    """Engine placement for sequence-parallel (long-context) serving.
+
+    Same contract as ``ReplicaSet`` but the SEQUENCE axis (axis 1 of
+    [B, S] batch arrays) is sharded over the mesh's 'sp' axis — the
+    layout ring attention consumes (``parallel/ring.py``): each device
+    holds its local Q and K/V blocks; K/V blocks rotate over ICI via
+    ppermute.
+
+    Works on a 1-D ``('sp',)`` mesh (batch replicated) or a 2-D
+    ``('replica', 'sp')`` mesh (batch data-parallel over 'replica' so
+    the batch axis no longer idles — ``make_replica_sp_mesh``).
+    """
+
+    @property
+    def _has_replica(self) -> bool:
+        return "replica" in self.mesh.axis_names
+
+    def _batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("replica" if self._has_replica else None, "sp")
+
+    @property
+    def n_replicas(self) -> int:
+        return int(self.mesh.shape["replica"]) if self._has_replica else 1
+
+    def pad_multiple(self) -> int:
+        # Batch divisibility comes from the replica axis (1 on a pure
+        # sp mesh); the SEQ bucket must divide by the sp width.
+        return self.n_replicas
 
     def seq_multiple(self) -> int:
-        return self.n_replicas
+        return int(self.mesh.shape["sp"])

@@ -70,18 +70,24 @@ def make_ring_attention(mesh, axis: str = "sp"):
 
     Returns ``fn(q, k, v, key_mask) -> ctx`` with q/k/v [B, S, H, D] and
     key_mask [B, S]; S must divide evenly by the axis size.  Call it
-    inside jit with inputs sharded ``P(None, axis, None, None)`` (it is
-    a shard_map, so it composes with the surrounding program).
+    inside jit with inputs sharded seq-over-``axis`` (it is a
+    shard_map, so it composes with the surrounding program).
+
+    On a 2-D ``('replica', 'sp')`` mesh the batch axis additionally
+    shards over 'replica'; the ppermute ring stays within each replica
+    row (axis_name scopes the collective), so data-parallel groups run
+    independent rings — batch DP × sequence SP composed.
     """
+    batch_axis = "replica" if "replica" in mesh.axis_names else None
 
     def fn(q, k, v, key_mask):
         scale = 1.0 / math.sqrt(q.shape[-1])
         body = functools.partial(_ring_attn_local, axis_name=axis, scale=scale)
-        seq_sharded = P(None, axis, None, None)
+        seq_sharded = P(batch_axis, axis, None, None)
         return jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(seq_sharded, seq_sharded, seq_sharded, P(None, axis)),
+            in_specs=(seq_sharded, seq_sharded, seq_sharded, P(batch_axis, axis)),
             out_specs=seq_sharded,
             check_vma=False,
         )(q, k, v, key_mask)

@@ -58,7 +58,9 @@ def bert_param_spec(cfg):
     ln = {"scale": P(), "bias": P()}
     return {
         "embeddings": {
-            "word": {"embedding": P("tp", None)},
+            # Model-axis sharding: BERT's 30522 vocab rows don't divide
+            # by common tp widths (30522 % 4 != 0); hidden_size does.
+            "word": {"embedding": P(None, "tp")},
             "position": {"embedding": P()},
             "token_type": {"embedding": P()},
             "ln": ln,
@@ -67,6 +69,48 @@ def bert_param_spec(cfg):
         "pooler": {"kernel": P(), "bias": P()},
         "classifier": {"kernel": P(), "bias": P()},
     }
+
+
+def gpt_param_spec(cfg):
+    """PartitionSpec pytree matching ``gpt.init_params`` exactly.
+
+    Serving-side Megatron for the decoder: fused qkv + mlp-up are
+    column-parallel, attn-out + mlp-down row-parallel, wpe + norms
+    replicated.  wte shards on the MODEL axis, not the vocab axis —
+    GPT-2's 50257 rows divide by nothing useful, while d_model does;
+    the tied LM head's logits matmul then contracts over the sharded
+    model dim (an all-reduce XLA inserts).  XLA's sharding propagation
+    keeps semantics exact regardless of the head-boundary slicing of
+    the fused qkv — correctness comes from the logical program, the
+    spec only steers layout.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    col = {"kernel": P(None, "tp"), "bias": P("tp")}
+    row = {"kernel": P("tp", None), "bias": P()}
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "wte": {"embedding": P(None, "tp")},
+        "wpe": {"embedding": P()},
+        "layers": [
+            {
+                "ln1": ln,
+                "attn": {"qkv": col, "out": row},
+                "ln2": ln,
+                "mlp": {"up": col, "down": row},
+            }
+            for _ in range(cfg.num_layers)
+        ],
+        "final_ln": ln,
+    }
+
+
+PARAM_SPECS = {
+    # model-name prefix -> spec builder(cfg); used by the registry to
+    # turn TP=<n> into a servable TensorParallelSet placement.
+    "bert": bert_param_spec,
+    "gpt": gpt_param_spec,
+}
 
 
 def shard_params(params, spec, mesh):

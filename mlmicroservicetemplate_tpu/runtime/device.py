@@ -16,13 +16,48 @@ import dataclasses
 import os
 
 
+def enable_compilation_cache(device: str) -> str | None:
+    """Persistent XLA compilation cache: restarts reuse compiled
+    executables instead of re-paying warmup (52–487 s per model through
+    the remote-compile relay, BASELINE.md warmup table).
+
+    Default ON for DEVICE=tpu at ``~/.cache/mlmst-xla-cache``;
+    ``COMPILE_CACHE_DIR=<path>`` overrides, ``COMPILE_CACHE_DIR=`` /
+    ``=0`` disables.  Returns the active dir (None = disabled).
+    CPU compiles are fast and golden tests want cold compiles, so CPU
+    stays off unless a dir is given explicitly.
+    """
+    env = os.environ.get("COMPILE_CACHE_DIR")
+    if env is not None and env.strip().lower() in ("", "0", "false", "no", "off"):
+        return None
+    if env:
+        cache_dir = env
+    elif device == "tpu":
+        cache_dir = os.path.expanduser("~/.cache/mlmst-xla-cache")
+    else:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache everything the warmup compiles, not just slow ones: through
+    # the relay even "fast" compiles cost seconds of round-trips.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 def apply_device_env(device: str) -> None:
     """Map DEVICE=tpu|cpu onto JAX_PLATFORMS before jax is imported.
 
     tpu: leave platform selection to the environment (PJRT TPU plugin
     auto-registers; a broken TPU init should raise, not silently fall
     back to CPU). cpu: force the CPU backend.
+
+    Also enables the persistent compilation cache (see
+    ``enable_compilation_cache``).
     """
+    enable_compilation_cache(device)
     if device != "cpu":
         return
     os.environ["JAX_PLATFORMS"] = "cpu"

@@ -73,6 +73,9 @@ class Batcher:
             from ..engine.streams import ContinuousDecodeLoop
 
             self._cdl = ContinuousDecodeLoop(engine, cfg)
+            # MAX_STREAMS caps TOTAL concurrent generations: each side
+            # counts the other's active streams in its admission check.
+            self._cdl.external_active = lambda: self._active_streams
 
     # ------------------------------------------------------------------
     async def start(self) -> None:

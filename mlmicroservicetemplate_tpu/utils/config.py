@@ -67,7 +67,13 @@ class ServiceConfig(BaseModel):
     # Sequence-parallel width for long-context models (bert-long): the
     # sequence axis shards over an ('sp',) mesh and attention runs as a
     # ppermute ring (parallel/ring.py). 0 = every visible device.
+    # Combine with REPLICAS>=2 for a ('replica','sp') 2-D mesh (batch
+    # data-parallel on top of sequence parallelism).
     sp: int = 0
+    # Tensor-parallel width (bert-base / gpt2): params Megatron-sharded
+    # over the 'tp' axis of a ('replica','tp') mesh (parallel/tp.py
+    # specs), batch over 'replica'. 0 = off (pure replica DP).
+    tp: int = 0
 
     # Seq2seq decoding (T5).
     max_decode_len: int = 64
@@ -134,7 +140,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
 
     Recognized variables (reference-parity names first):
       DEVICE, MODEL_NAME, MODEL_PATH, TOKENIZER_PATH, HOST, PORT,
-      MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, SP,
+      MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, SP, TP,
       MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
       MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS, QUANTIZE,
       REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING.
@@ -168,6 +174,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "max_queue": "MAX_QUEUE",
         "replicas": "REPLICAS",
         "sp": "SP",
+        "tp": "TP",
         "max_decode_len": "MAX_DECODE_LEN",
         "pipeline_depth": "PIPELINE_DEPTH",
         "max_streams": "MAX_STREAMS",

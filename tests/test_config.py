@@ -42,3 +42,17 @@ def test_bad_device_rejected():
 def test_bad_max_batch_rejected():
     with pytest.raises(Exception):
         load_config(env={"DEVICE": "cpu", "MAX_BATCH": "0"})
+
+
+def test_compilation_cache_gating(tmp_path, monkeypatch):
+    """COMPILE_CACHE_DIR: explicit dir wins, empty/0 disables, CPU
+    default is off (golden tests want cold compiles)."""
+    from mlmicroservicetemplate_tpu.runtime.device import enable_compilation_cache
+
+    monkeypatch.setenv("COMPILE_CACHE_DIR", str(tmp_path / "xla"))
+    assert enable_compilation_cache("cpu") == str(tmp_path / "xla")
+    assert (tmp_path / "xla").is_dir()
+    monkeypatch.setenv("COMPILE_CACHE_DIR", "0")
+    assert enable_compilation_cache("tpu") is None
+    monkeypatch.delenv("COMPILE_CACHE_DIR")
+    assert enable_compilation_cache("cpu") is None

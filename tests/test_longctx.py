@@ -34,7 +34,10 @@ def test_bert_long_ring_matches_dense(cpu_devices):
     bundle = build_model(cfg)
     engine = InferenceEngine(bundle, cfg)
     assert isinstance(engine.replicas, SeqParallelSet)
-    assert engine.replicas.n_replicas == 8
+    # 1-D sp mesh: all 8 devices carry sequence shards; batch-DP width 1.
+    assert engine.replicas.n_devices == 8
+    assert engine.replicas.seq_multiple() == 8
+    assert engine.replicas.n_replicas == 1
 
     rng = np.random.RandomState(3)
     texts_lens = [40, 17]
