@@ -108,9 +108,7 @@ async def bench_serving() -> "tuple[dict, object]":
             ),
             "req_s": round(N_THROUGHPUT / wall, 3),
             "backend": jax.default_backend(),
-            "n_devices": getattr(
-                engine.replicas, "n_devices", engine.replicas.n_replicas
-            ),
+            "n_devices": engine.replicas.n_devices,
         }, engine
     finally:
         await client.close()

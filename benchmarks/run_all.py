@@ -46,9 +46,7 @@ async def main() -> None:
     ) as s:
         r2 = await s.latency(post_text("a short benchmark sentence"))
         rows.append({"config": "bert-base batch=1 latency", **r2})
-        n_dev = getattr(
-            s.engine.replicas, "n_devices", s.engine.replicas.n_replicas
-        )
+        n_dev = s.engine.replicas.n_devices
         r4 = await s.throughput(post_text("a short benchmark sentence"))
         rows.append(
             {"config": f"bert-base replica serving ({n_dev} device)", **r4}

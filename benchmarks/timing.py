@@ -66,7 +66,7 @@ def device_time_per_call(fn, args, carry_idx: int = -1, iters: int = 8,
     return per, noisy
 
 
-def chunked_time_per_step(jit_chunk, params, state, iters: int = 16,
+def chunked_time_per_step(jit_chunk, params, state, iters: int | None = None,
                           reps: int = REPS):
     """Per-decode-step device seconds for a generate_chunk-style
     executable (``jit_chunk(params, state, n_steps) -> (state, toks)``,
@@ -76,8 +76,18 @@ def chunked_time_per_step(jit_chunk, params, state, iters: int = 16,
     The state is NOT threaded between timed calls (each call re-decodes
     from the same state — steady-state work per step, no drift in shapes
     or content), so ``jit_chunk`` must not donate its state argument.
+
+    iters defaults to CHUNK_ITERS (64): per-step times are fractions of
+    a millisecond, so short chunks drown in relay jitter — K must be
+    large enough that K x step_time clears ±10 ms.  Steps past the
+    decode budget are harmless (token/cache writes are mode="drop").
     """
+    import os
+
     import jax
+
+    if iters is None:
+        iters = int(os.environ.get("CHUNK_ITERS", "64"))
 
     def wall(n: int) -> float:
         jax.device_get(jit_chunk(params, state, n)[1])  # compile

@@ -317,7 +317,7 @@ async def handle_status(request: web.Request) -> web.Response:
         "kind": bundle.kind,
         "ready": app["ready"].is_set(),
         "device": jax.default_backend(),
-        "n_devices": getattr(engine.replicas, "n_devices", engine.replicas.n_replicas),
+        "n_devices": engine.replicas.n_devices,
         "max_batch": app["cfg"].max_batch,
         "uptime_s": round(time.time() - app["started_at"], 1),
         # Compiled-executable inventory + startup cost: the operator-

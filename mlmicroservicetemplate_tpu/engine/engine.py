@@ -78,11 +78,12 @@ class InferenceEngine:
         )
 
         if bundle.kind == KIND_SEQ2SEQ:
-            # static: n_steps, sample-path flag; donated: the decode
-            # state (every caller reassigns it, and donation keeps the
-            # big KV buffers in place across chunk dispatches).
+            # static: n_steps, sample-path flag.  NOT donated: the
+            # continuous-batching loop pipelines chunk dispatches and
+            # holds the previous state's `done`/token buffers across the
+            # next call — donation would invalidate them mid-flight.
             self._gen_chunk = jax.jit(
-                bundle.generate_chunk_fn, static_argnums=(2, 3), donate_argnums=(1,)
+                bundle.generate_chunk_fn, static_argnums=(2, 3)
             )
 
             # encode + cache init + first decode chunk fused into ONE

@@ -101,6 +101,13 @@ class ContinuousDecodeLoop:
         self.free: list[int] = list(range(self.n_slots))
         self._state = None  # batched decode state (device), loop-thread-owned
         self._insert = None
+        # Depth-1 decode pipelining: the state chain is pure device-side,
+        # so chunk k+1 dispatches BEFORE chunk k's tokens are fetched —
+        # the ~RTT-long fetch overlaps the next chunk's compute and its
+        # async host copy.  Each entry: (toks, done, {slot: stream at
+        # dispatch time}).  Snapshots keep late-arriving tokens from
+        # leaking into a slot's next tenant.
+        self._inflight_chunks: list = []
         self._admitted = 0  # event-loop-owned admission counter
         # Streams running OUTSIDE this loop (the Batcher's legacy
         # per-stream path for oversized prompts) count against the same
@@ -200,17 +207,41 @@ class ContinuousDecodeLoop:
         log.info("continuous decode loop up: %d slots", self.n_slots)
         while not self._stop.is_set():
             try:
-                if not self.active and self.pending.empty():
+                if (
+                    not self.active
+                    and not self._inflight_chunks
+                    and self.pending.empty()
+                ):
                     try:
                         st = self.pending.get(timeout=0.05)
                     except queue_mod.Empty:
                         continue
-                    self._admit(st)
-                # Chunk boundary: admit everything that fits.
-                while self.free and not self.pending.empty():
-                    self._admit(self.pending.get_nowait())
+                    wave = [st]
+                else:
+                    wave = []
+                # Chunk boundary: admit everything that fits, as ONE
+                # wave — N prefill dispatches queue on the device and a
+                # single combined transfer fetches all their first
+                # chunks, so a wave costs one round-trip, not N.
+                while (
+                    len(wave) + len(self.active) < self.n_slots
+                    and not self.pending.empty()
+                ):
+                    wave.append(self.pending.get_nowait())
+                if wave:
+                    self._admit_wave(wave)
+                # Depth-1 pipeline: keep ONE chunk in flight while
+                # streams are active — deliver chunk k only after chunk
+                # k+1 has dispatched, so k's blocking fetch overlaps
+                # k+1's compute + async host copy.  Tokens arrive one
+                # chunk-compute later; each inter-chunk wall drops by
+                # up to a full round-trip.  Drain when nothing dispatches.
                 if self.active:
                     self._dispatch_chunk()
+                if len(self._inflight_chunks) > 1 or (
+                    self._inflight_chunks and not self.active
+                ):
+                    self._deliver_oldest()
             except Exception as e:  # pragma: no cover - defensive
                 log.exception("decode loop iteration failed")
                 for slot in list(self.active):
@@ -221,6 +252,7 @@ class ContinuousDecodeLoop:
                 # A failed dispatch may have already consumed (donated)
                 # the state buffers — rebuild lazily on next admission.
                 self._state = None
+                self._inflight_chunks.clear()
                 self.sampled_slots.clear()
         # Shutdown: end every remaining consumer cleanly.
         while not self.pending.empty():
@@ -236,61 +268,83 @@ class ContinuousDecodeLoop:
 
     # -- admission -----------------------------------------------------
 
-    def _admit(self, st: _Stream) -> None:
+    def _admit_wave(self, wave: list[_Stream]) -> None:
+        """Admit a wave of pending streams at one chunk boundary.
+
+        All prefill dispatches are queued back-to-back on the device,
+        then ONE combined ``device_get`` fetches every stream's first
+        chunk + done flag — through a relay where each transfer costs a
+        full round-trip, a wave of N admissions pays ~one RTT, not N.
+        """
         import jax
 
         eng = self.engine
-        if st.cancelled.is_set():
-            self._release(st)
-            return
-        if int(st.feats.get("length", 0)) > self.max_prompt:
-            # Callers normally route oversized prompts to the
-            # per-stream path; direct misuse gets a clean error.
-            self._finish(st, ValueError(
-                f"prompt longer than the largest seq bucket "
-                f"({self.max_prompt}) cannot join the shared batch"
-            ))
-            return
-        try:
-            with eng._lock:
-                ids, mask, _ = eng._collate_text([st.feats])
-                sp, sampled = eng._collate_sample([st.feats], ids.shape[0])
-                ids, mask = eng.replicas.place_batch(ids, mask)
-                # Prefill at the request's own prompt bucket, fused with
-                # the first decode chunk — TTFT identical to solo serving.
-                state1, toks = eng._start(
-                    eng.params, ids, mask, sp,
-                    eng.max_decode_len, eng.chunk_tokens, sampled,
-                )
-                toks_np, done_np = jax.device_get((toks, state1.done))
-        except Exception as e:
-            self._finish(st, e)
-            return
-        self.prefill_dispatches += 1
-        st.produced = eng.chunk_tokens
-        st.emit(toks_np[0])
-        metrics.TOKENS.labels(eng.bundle.name).inc(int(toks_np[0].size))
-        if bool(done_np[0]) or st.produced >= eng.max_decode_len:
-            self._finish(st)
-            return
-        # Any failure from here (empty-state build OOM, insert compile)
-        # must terminate THIS consumer and return the slot — the _run
-        # handler only reaches streams already in self.active.
-        slot = None
-        try:
-            if self._state is None:
-                self._build_empty_state()
-            slot = self.free.pop()
-            with eng._lock:
-                self._state = self._insert_fn()(self._state, state1, np.int32(slot))
-        except Exception as e:
-            if slot is not None:
-                self.free.append(slot)
-            self._finish(st, e)
-            return
-        self.active[slot] = st
-        if sampled:
-            self.sampled_slots.add(slot)
+        started: list[tuple[_Stream, Any, Any, bool]] = []
+        fetch: list[Any] = []
+        with eng._lock:
+            for st in wave:
+                if st.cancelled.is_set():
+                    self._release(st)
+                    continue
+                if int(st.feats.get("length", 0)) > self.max_prompt:
+                    # Callers normally route oversized prompts to the
+                    # per-stream path; direct misuse gets a clean error.
+                    self._finish(st, ValueError(
+                        f"prompt longer than the largest seq bucket "
+                        f"({self.max_prompt}) cannot join the shared batch"
+                    ))
+                    continue
+                try:
+                    ids, mask, _ = eng._collate_text([st.feats])
+                    sp, sampled = eng._collate_sample([st.feats], ids.shape[0])
+                    ids, mask = eng.replicas.place_batch(ids, mask)
+                    # Prefill at the request's own prompt bucket, fused
+                    # with the first decode chunk — TTFT = solo serving.
+                    state1, toks = eng._start(
+                        eng.params, ids, mask, sp,
+                        eng.max_decode_len, eng.chunk_tokens, sampled,
+                    )
+                except Exception as e:
+                    self._finish(st, e)
+                    continue
+                self.prefill_dispatches += 1
+                started.append((st, state1, toks, sampled))
+                fetch.append((toks, state1.done))
+            if not started:
+                return
+            try:
+                fetched = jax.device_get(fetch)
+            except Exception as e:
+                for st, *_ in started:
+                    self._finish(st, e)
+                return
+        for (st, state1, _, sampled), (toks_np, done_np) in zip(started, fetched):
+            st.produced = eng.chunk_tokens
+            st.emit(toks_np[0])
+            metrics.TOKENS.labels(eng.bundle.name).inc(int(toks_np[0].size))
+            if bool(done_np[0]) or st.produced >= eng.max_decode_len:
+                self._finish(st)
+                continue
+            # Any failure from here (empty-state build OOM, insert
+            # compile) must terminate THIS consumer and return the slot
+            # — the _run handler only reaches streams in self.active.
+            slot = None
+            try:
+                if self._state is None:
+                    self._build_empty_state()
+                slot = self.free.pop()
+                with eng._lock:
+                    self._state = self._insert_fn()(
+                        self._state, state1, np.int32(slot)
+                    )
+            except Exception as e:
+                if slot is not None:
+                    self.free.append(slot)
+                self._finish(st, e)
+                continue
+            self.active[slot] = st
+            if sampled:
+                self.sampled_slots.add(slot)
 
     def _build_empty_state(self) -> None:
         """All-slots-done decode state from a max-bucket prefill
@@ -312,8 +366,13 @@ class ContinuousDecodeLoop:
             template,
         )
         # Dead rows: done=True masks every output; other fields are
-        # don't-cares until insert overwrites the row.
-        self._state = empty._replace(done=np.ones((self.n_slots,), bool))
+        # don't-cares until insert overwrites the row.  device_put NOW:
+        # leaving numpy leaves here would defer a multi-MB host→device
+        # upload of the whole slot state into the first admission.
+        self._state = jax.device_put(
+            empty._replace(done=np.ones((self.n_slots,), bool))
+        )
+        jax.block_until_ready(jax.tree.leaves(self._state)[0])
 
     def _insert_fn(self):
         if self._insert is None:
@@ -338,27 +397,46 @@ class ContinuousDecodeLoop:
 
                 return jax.tree.map(ins, batched, single)
 
-            # Donate the batched state: insert is a row overwrite, the
-            # old buffers are dead the moment the new state exists.
-            self._insert = jax.jit(insert, donate_argnums=(0,))
+            # NOT donated: in-flight pipelined chunks still reference
+            # buffers of the pre-insert state (their toks/done fetch
+            # later); donation would invalidate them mid-flight.
+            self._insert = jax.jit(insert)
         return self._insert
 
     # -- decode --------------------------------------------------------
 
     def _dispatch_chunk(self) -> None:
-        import jax
-
         eng = self.engine
         use_sample = bool(self.sampled_slots)
         with eng._lock:
             self._state, toks = eng._gen_chunk(
                 eng.params, self._state, eng.chunk_tokens, use_sample
             )
-            toks_np, done_np = jax.device_get((toks, self._state.done))
+        done = self._state.done
+        # Start the host copies now so the fetch in _deliver_oldest
+        # finds the data (mostly) already on this side of the wire.
+        for arr in (toks, done):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass  # backend without async copies: fetch pays the RTT
         self.chunk_dispatches += 1
         metrics.STREAM_BATCH.labels(eng.bundle.name).observe(len(self.active))
-        for slot in list(self.active):
-            st = self.active[slot]
+        self._inflight_chunks.append((toks, done, dict(self.active)))
+
+    def _deliver_oldest(self) -> None:
+        import jax
+
+        if not self._inflight_chunks:
+            return
+        eng = self.engine
+        toks, done, snapshot = self._inflight_chunks.pop(0)
+        toks_np, done_np = jax.device_get((toks, done))
+        for slot, st in snapshot.items():
+            # The slot may have been freed (and possibly re-tenanted)
+            # since this chunk dispatched — never emit stale rows.
+            if self.active.get(slot) is not st:
+                continue
             if st.cancelled.is_set():
                 self._free_slot(slot)
                 continue

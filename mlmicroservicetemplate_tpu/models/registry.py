@@ -289,6 +289,15 @@ def _build_bert_long(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     params = cast_pytree(params, policy.param_jnp)
     params = _maybe_quantize(params, svc_cfg)
 
+    # bert-long scales with SP (+ REPLICAS), never TP — fail loudly so
+    # a TP knob is not silently swallowed by the SP placement below
+    # (build_model's generic guard can't see past make_placement).
+    if int(getattr(svc_cfg, "tp", 0) or 0) > 1:
+        raise ValueError(
+            "TP is not supported for bert-long; scale long-context via "
+            "SP=<width> and REPLICAS=<n> (a ('replica','sp') mesh)"
+        )
+
     # REPLICAS>=2 composes batch DP on top of sequence parallelism:
     # a ('replica','sp') mesh whose rows are independent ppermute
     # rings (round-2 verdict: the 1-D sp mesh idled the batch axis).
