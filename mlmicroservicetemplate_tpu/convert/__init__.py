@@ -11,6 +11,7 @@ materialized straight into HBM by the runtime with a chosen sharding.
 from .hf_maps import (
     bert_state_to_pytree,
     gpt2_state_to_pytree,
+    llama_state_to_pytree,
     resnet_state_to_pytree,
     t5_state_to_pytree,
 )
@@ -18,6 +19,7 @@ from .hf_maps import (
 __all__ = [
     "bert_state_to_pytree",
     "gpt2_state_to_pytree",
+    "llama_state_to_pytree",
     "resnet_state_to_pytree",
     "t5_state_to_pytree",
 ]

@@ -23,6 +23,7 @@ CONVERTERS = {
     "bert-base": "bert_state_to_pytree",
     "t5-small": "t5_state_to_pytree",
     "gpt2": "gpt2_state_to_pytree",
+    "llama": "llama_state_to_pytree",
 }
 
 

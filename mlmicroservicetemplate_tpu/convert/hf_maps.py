@@ -221,3 +221,51 @@ def gpt2_state_to_pytree(state: State, n_layers: int = 12) -> dict:
             }
         )
     return p
+
+
+def llama_state_to_pytree(state: State, n_layers: int | None = None) -> dict:
+    """HF Llama-family names → ``models/llama.init_params`` layout.
+
+    All projections are ``nn.Linear`` ([out, in] → transpose); norms are
+    RMSNorm weight vectors; ``lm_head.weight`` [V, D] transposes to the
+    untied [D, V] kernel.  Tied-embedding checkpoints (no ``lm_head``
+    key) fall back to the embedding table transposed.
+    """
+    if n_layers is None:
+        n_layers = 1 + max(
+            int(k.split(".")[2])
+            for k in state
+            if k.startswith("model.layers.")
+        )
+
+    def lin(prefix: str) -> dict:
+        return {"kernel": _lin(state[f"{prefix}.weight"])}
+
+    embed_w = state["model.embed_tokens.weight"]
+    head = state.get("lm_head.weight", embed_w)
+    p: dict = {
+        "embed": {"embedding": embed_w},
+        "layers": [],
+        "final_ln": {"scale": state["model.norm.weight"]},
+        "lm_head": {"kernel": _lin(head)},
+    }
+    for i in range(n_layers):
+        b = f"model.layers.{i}"
+        p["layers"].append(
+            {
+                "attn_ln": {"scale": state[f"{b}.input_layernorm.weight"]},
+                "attn": {
+                    "q": lin(f"{b}.self_attn.q_proj"),
+                    "k": lin(f"{b}.self_attn.k_proj"),
+                    "v": lin(f"{b}.self_attn.v_proj"),
+                    "o": lin(f"{b}.self_attn.o_proj"),
+                },
+                "mlp_ln": {"scale": state[f"{b}.post_attention_layernorm.weight"]},
+                "mlp": {
+                    "gate": lin(f"{b}.mlp.gate_proj"),
+                    "up": lin(f"{b}.mlp.up_proj"),
+                    "down": lin(f"{b}.mlp.down_proj"),
+                },
+            }
+        )
+    return p

@@ -1,0 +1,328 @@
+"""Llama-family decoder (RoPE + GQA + SwiGLU), pure-JAX, KV-cached.
+
+Model-family breadth beyond the reference's zoo (SURVEY.md §2 serves
+ResNet/BERT/T5; round 2 added GPT-2): this is the modern-decoder
+member — the architecture family (Llama/Mistral/TinyLlama/Qwen-style)
+a 2026 user actually brings to a serving template.  Servable as
+``MODEL_NAME=llama`` through the SAME machinery as GPT-2: the
+encode/init/generate_chunk trio, fused prefill+first-chunk dispatch,
+continuous batching, per-request sampling, TP sharding.
+
+Architecture: pre-norm RMSNorm blocks, rotary position embeddings
+(HF rotate-half convention), grouped-query attention (num_kv_heads <
+num_heads; K/V cached at KV width and broadcast to query heads at
+attention time), SwiGLU MLP (down(silu(gate)·up)), no biases anywhere,
+untied LM head.
+
+Decode reuses ``gpt.GPTState`` verbatim — the per-row
+(write_idx/key_valid/pos/rng) state contract is what the continuous
+batching loop and the engine already speak.  RoPE is applied BEFORE
+caching K (the standard layout), so cached keys never need re-rotation;
+each row rotates its new K/Q at its OWN position.
+
+Checkpoint mapping: ``convert/hf_maps.llama_state_to_pytree`` (HF
+``model.layers.i.self_attn.{q,k,v,o}_proj`` etc., nn.Linear [out,in]
+weights transposed to [in,out]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    Params,
+    dense,
+    dense_init,
+    embed,
+    lm_head_logits,
+    merge_heads,
+    mha_attention,
+    normal_init,
+    rmsnorm,
+    rmsnorm_init,
+)
+from .gpt import GPTState
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    # Defaults = TinyLlama-1.1B (the smallest real Llama-family
+    # checkpoint people serve); tests use tiny overrides.
+    vocab_size: int = 32000
+    d_model: int = 2048
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    num_layers: int = 22
+    d_ff: int = 5632
+    max_position: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    bos_id: int = 1
+    eos_id: int = 2
+    pad_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def n_rep(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+
+
+def init_params(key, cfg: LlamaConfig = LlamaConfig()) -> Params:
+    keys = jax.random.split(key, cfg.num_layers + 2)
+    d, kv_dim = cfg.d_model, cfg.num_kv_heads * cfg.head_dim
+    params: Params = {
+        "embed": {"embedding": normal_init(keys[0], (cfg.vocab_size, d), std=0.02)},
+        "layers": [],
+        "final_ln": rmsnorm_init(d),
+        "lm_head": {"kernel": normal_init(keys[1], (d, cfg.vocab_size), std=0.02)},
+    }
+    for i in range(cfg.num_layers):
+        k = jax.random.split(keys[2 + i], 7)
+        params["layers"].append(
+            {
+                "attn_ln": rmsnorm_init(d),
+                "attn": {
+                    "q": dense_init(k[0], d, d, bias=False, std=0.02),
+                    "k": dense_init(k[1], d, kv_dim, bias=False, std=0.02),
+                    "v": dense_init(k[2], d, kv_dim, bias=False, std=0.02),
+                    "o": dense_init(k[3], d, d, bias=False, std=0.02),
+                },
+                "mlp_ln": rmsnorm_init(d),
+                "mlp": {
+                    "gate": dense_init(k[4], d, cfg.d_ff, bias=False, std=0.02),
+                    "up": dense_init(k[5], d, cfg.d_ff, bias=False, std=0.02),
+                    "down": dense_init(k[6], cfg.d_ff, d, bias=False, std=0.02),
+                },
+            }
+        )
+    return params
+
+
+# ---------------------------------------------------------------------------
+# rotary embeddings (HF rotate-half convention)
+
+
+def _rope_tables(cfg: LlamaConfig, positions: jax.Array, dtype):
+    """cos/sin [..., head_dim] for integer positions [...]."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (
+        cfg.rope_theta
+        ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / cfg.head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    emb = jnp.concatenate([angles, angles], axis=-1)  # [..., head_dim]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, S, H, D]; cos/sin broadcastable to [B, S, 1, D]."""
+    return x * cos + _rotate_half(x) * sin
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, KVH, D] -> [B, S, KVH*n_rep, D] (GQA broadcast)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def _split(x: jax.Array, n_heads: int) -> jax.Array:
+    b, s, d = x.shape
+    return x.reshape(b, s, n_heads, d // n_heads)
+
+
+# ---------------------------------------------------------------------------
+# prefill
+
+
+def forward_hidden(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jax.Array,  # [B, S]
+    attention_mask: jax.Array,  # [B, S]
+    dtype=jnp.float32,
+    collect_kv: bool = False,
+):
+    """Hidden states [B, S, D] (+ per-layer ROTATED prompt K / V)."""
+    b, s = input_ids.shape
+    x = embed(params["embed"], input_ids, dtype)
+    pos = jnp.arange(s, dtype=jnp.int32)
+    cos, sin = _rope_tables(cfg, pos, dtype)  # [S, D_h]
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    mask = causal[None, None] & (attention_mask[:, None, None, :] != 0)
+    kv = []
+    for layer in params["layers"]:
+        h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
+        a = layer["attn"]
+        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
+        k = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
+        v = _split(dense(a["v"], h), cfg.num_kv_heads)
+        if collect_kv:
+            kv.append((k, v))
+        ctx = mha_attention(
+            q, _repeat_kv(k, cfg.n_rep), _repeat_kv(v, cfg.n_rep), mask=mask
+        )
+        x = x + dense(a["o"], merge_heads(ctx))
+        h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
+        m = layer["mlp"]
+        x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
+    x = rmsnorm(params["final_ln"], x, eps=cfg.rms_eps)
+    return (x, kv) if collect_kv else x
+
+
+def lm_logits(
+    params: Params, cfg: LlamaConfig, input_ids, attention_mask, dtype=jnp.float32
+) -> jax.Array:
+    """[B, S, V] next-token logits (the non-generative forward)."""
+    x = forward_hidden(params, cfg, input_ids, attention_mask, dtype)
+    return lm_head_logits(x, params["lm_head"]["kernel"], transposed=False)
+
+
+# ---------------------------------------------------------------------------
+# incremental decode (state layout shared with gpt.GPTState)
+
+
+def init_decode_state(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jax.Array,  # [B, S] right-padded
+    attention_mask: jax.Array,  # [B, S]
+    max_len: int,
+    dtype=jnp.float32,
+    sample=None,
+) -> GPTState:
+    from .sampling import greedy_params
+
+    b, s = input_ids.shape
+    total = s + max_len
+    _, kv = forward_hidden(
+        params, cfg, input_ids, attention_mask, dtype, collect_kv=True
+    )
+    cache_k, cache_v = [], []
+    for k, v in kv:
+        ck = jnp.zeros((b, total, cfg.num_kv_heads, cfg.head_dim), k.dtype)
+        cache_k.append(ck.at[:, :s].set(k))
+        cache_v.append(ck.at[:, :s].set(v))
+    lengths = attention_mask.sum(axis=-1).astype(jnp.int32)
+    key_valid = jnp.zeros((b, total), jnp.int32).at[:, :s].set(
+        attention_mask.astype(jnp.int32)
+    )
+    rows = jnp.arange(b)
+    last_tok = input_ids[rows, jnp.maximum(lengths - 1, 0)]
+    return GPTState(
+        cache_k=cache_k,
+        cache_v=cache_v,
+        key_valid=key_valid,
+        write_idx=jnp.maximum(lengths - 1, 0),
+        pos=jnp.zeros((b,), jnp.int32),
+        last_token=last_tok.astype(jnp.int32),
+        done=lengths == 0,
+        tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+        sample=sample if sample is not None else greedy_params(b),
+    )
+
+
+def _decode_step(params: Params, cfg: LlamaConfig, state: GPTState, sample: bool = False):
+    dtype = state.cache_k[0].dtype
+    b = state.last_token.shape[0]
+    rows = jnp.arange(b)
+    t = state.write_idx  # [B] per-row position
+    x = embed(params["embed"], state.last_token[:, None], dtype)  # [B,1,D]
+    # Per-row rotary tables at each row's own position (clamped for
+    # long-dead continuous-batching rows whose writes drop anyway).
+    cos, sin = _rope_tables(cfg, jnp.minimum(t, cfg.max_position - 1), dtype)
+    cos, sin = cos[:, None, None, :], sin[:, None, None, :]  # [B,1,1,D_h]
+    key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
+    attn_mask = (key_valid != 0)[:, None, None, :]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
+        a = layer["attn"]
+        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        ck = state.cache_k[li].at[rows, t].set(k1[:, 0], mode="drop")
+        cv = state.cache_v[li].at[rows, t].set(v1[:, 0], mode="drop")
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(
+            q, _repeat_kv(ck, cfg.n_rep), _repeat_kv(cv, cfg.n_rep), mask=attn_mask
+        )
+        x = x + dense(a["o"], merge_heads(ctx))
+        h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
+        m = layer["mlp"]
+        x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
+    x = rmsnorm(params["final_ln"], x, eps=cfg.rms_eps)
+    logits = lm_head_logits(x[:, 0], params["lm_head"]["kernel"], transposed=False)
+
+    if sample:
+        from .sampling import select_token
+
+        next_tok, sp = select_token(logits, state.sample)
+    else:
+        next_tok, sp = jnp.argmax(logits, axis=-1).astype(jnp.int32), state.sample
+    next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
+    done = state.done | (next_tok == cfg.eos_id)
+    tokens = state.tokens.at[rows, state.pos].set(next_tok, mode="drop")
+    return (
+        GPTState(
+            cache_k=new_k,
+            cache_v=new_v,
+            key_valid=key_valid,
+            write_idx=t + 1,
+            pos=state.pos + 1,
+            last_token=next_tok,
+            done=done,
+            tokens=tokens,
+            sample=sp,
+        ),
+        next_tok,
+    )
+
+
+def generate_chunk(
+    params: Params, cfg: LlamaConfig, state: GPTState, n_steps: int, sample: bool = False
+) -> tuple[GPTState, jax.Array]:
+    """``n_steps`` decode steps in one compiled scan — the engine's
+    chunk contract (static ``sample`` picks argmax vs sampling path)."""
+
+    def step(s, _):
+        return _decode_step(params, cfg, s, sample)
+
+    state, toks = jax.lax.scan(step, state, None, length=n_steps)
+    return state, jnp.transpose(toks)
+
+
+def greedy_generate(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    max_len: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Prefill + full decode scan, single dispatch → [B, max_len]."""
+    state = init_decode_state(params, cfg, input_ids, attention_mask, max_len, dtype)
+    state, _ = generate_chunk(params, cfg, state, max_len)
+    return state.tokens

@@ -479,13 +479,119 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     )
 
 
+def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
+    """Llama-family decoder (RoPE/GQA/SwiGLU — models/llama.py), served
+    through the same seq2seq machinery as GPT-2 (fused prefill, chunked
+    decode, continuous batching, sampling, TP).
+
+    Default dims = TinyLlama-1.1B; ``LLAMA_CONFIG`` env takes a JSON
+    object of LlamaConfig overrides (e.g. '{"num_layers": 16}') so one
+    builder serves the whole dims family without code changes.
+    """
+    import json as _json
+    import math as _math
+    import os as _os
+
+    from ..convert import llama_state_to_pytree
+    from . import llama as llama_mod
+    from .common import cast_pytree
+
+    # Llama input convention is the INVERSE of T5's: prompts start with
+    # <s> (BOS) and must NOT end in </s> — a trailing EOS conditions the
+    # model on end-of-document and derails generation.  SentencePiece
+    # assets get the convention natively; other paths use the for_t5
+    # fallback (byte fallback/eos layouts, bos-less).
+    tok_path = svc_cfg.tokenizer_path
+    if tok_path and tok_path.endswith((".model", ".tsv", ".vocab")):
+        from .sentencepiece import load_sentencepiece
+
+        tokenizer = load_sentencepiece(tok_path, add_eos=False, add_bos=True)
+    else:
+        tokenizer = build_tokenizer(tok_path, for_t5=True)
+    overrides = {}
+    env_cfg = _os.environ.get("LLAMA_CONFIG")
+    if env_cfg:
+        overrides = _json.loads(env_cfg)
+    # Model-side EOS/pad must be the TOKENIZER's ids (gpt2 precedent):
+    # a mismatch would leave streams decoding the full budget while the
+    # detokenizer silently truncates at its own eos.
+    overrides.setdefault("eos_id", int(tokenizer.eos_id))
+    overrides.setdefault("pad_id", int(tokenizer.pad_id))
+    cfg = llama_mod.LlamaConfig(**overrides)
+
+    max_id = int(getattr(tokenizer, "max_token_id",
+                         getattr(tokenizer, "vocab_size", 1) - 1))
+    if max_id >= cfg.vocab_size:
+        raise ValueError(
+            f"tokenizer at {svc_cfg.tokenizer_path!r} can emit id {max_id} "
+            f">= llama embedding table rows {cfg.vocab_size}"
+        )
+    if not (0 <= cfg.eos_id < cfg.vocab_size and 0 <= cfg.pad_id < cfg.vocab_size):
+        raise ValueError(
+            f"eos_id={cfg.eos_id}/pad_id={cfg.pad_id} outside llama vocab "
+            f"of {cfg.vocab_size}"
+        )
+    params = _load_or_init("llama", svc_cfg.model_path,
+                           functools.partial(llama_mod.init_params, cfg=cfg),
+                           llama_state_to_pytree)
+    params = cast_pytree(params, policy.param_jnp)
+    params = _maybe_quantize(params, svc_cfg)
+
+    # Same position-budget arithmetic as gpt2: decode must fit inside
+    # max_position after the prompt bucket.
+    chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
+    decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
+    if decode_budget >= cfg.max_position:
+        raise ValueError(
+            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} leaves no room "
+            f"for a prompt within llama's {cfg.max_position} positions"
+        )
+    max_prompt = cfg.max_position - decode_budget
+    bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
+    if bad:
+        raise ValueError(
+            f"SEQ_BUCKETS {bad} exceed llama's position budget: max prompt = "
+            f"{cfg.max_position} - {decode_budget} decode = {max_prompt}"
+        )
+
+    def encode_fn(p, input_ids, attention_mask):
+        return input_ids
+
+    def init_state_fn(p, input_ids, enc_mask, max_len: int, sample=None):
+        return llama_mod.init_decode_state(
+            p, cfg, input_ids, enc_mask, max_len, dtype=policy.compute_jnp,
+            sample=sample,
+        )
+
+    def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
+        return llama_mod.generate_chunk(p, cfg, state, n_steps, sample)
+
+    return ModelBundle(
+        name="llama",
+        kind=KIND_SEQ2SEQ,
+        cfg=cfg,
+        params=params,
+        policy=policy,
+        tokenizer=tokenizer,
+        labels=None,
+        forward=None,
+        encode_fn=encode_fn,
+        init_state_fn=init_state_fn,
+        generate_chunk_fn=generate_chunk_fn,
+        max_prompt_len=max_prompt,
+        make_placement=_tp_placement(svc_cfg, cfg, "llama"),
+    )
+
+
 MODEL_REGISTRY: dict[str, Callable] = {
     "resnet50": _build_resnet,
     "bert-base": _build_bert,
     "bert-long": _build_bert_long,
     "t5-small": _build_t5,
     "gpt2": _build_gpt,
+    "llama": _build_llama,
 }
+MODEL_REGISTRY["tinyllama"] = _build_llama
 # Aliases for HF-style names the reference's configs use.
 MODEL_REGISTRY["resnet-50"] = _build_resnet
 MODEL_REGISTRY["bert-base-uncased"] = _build_bert

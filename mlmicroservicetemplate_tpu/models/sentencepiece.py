@@ -173,9 +173,13 @@ class SentencePieceTokenizer:
     trailing ``</s>`` on encode.
     """
 
-    def __init__(self, pieces: list[tuple[str, float, int]], add_eos: bool = True):
+    def __init__(self, pieces: list[tuple[str, float, int]], add_eos: bool = True,
+                 add_bos: bool = False):
         self.pieces = pieces
         self.add_eos = add_eos
+        # Llama-family convention: prompts start with <s> and do NOT end
+        # in </s> (the exact inverse of T5's add_eos).
+        self.add_bos = add_bos
         self.vocab: dict[str, int] = {}
         self.byte_pieces: dict[int, int] = {}
         self.scores = np.full((len(pieces),), -1e9, np.float32)
@@ -265,6 +269,8 @@ class SentencePieceTokenizer:
 
     def encode(self, text: str, max_len: int) -> tuple[np.ndarray, np.ndarray]:
         ids = self._segment(self._normalize(text))
+        if self.add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
         if self.add_eos:
             ids = ids[: max_len - 1] + [self.eos_id]
         else:
@@ -309,10 +315,11 @@ class SentencePieceTokenizer:
         return text[1:] if text.startswith(" ") else text
 
 
-def load_sentencepiece(path: str, add_eos: bool = True) -> SentencePieceTokenizer:
+def load_sentencepiece(path: str, add_eos: bool = True,
+                       add_bos: bool = False) -> SentencePieceTokenizer:
     """Build from a binary ``spiece.model`` or a ``piece\\tscore`` tsv."""
     if path.endswith((".tsv", ".vocab")):
         pieces = load_piece_tsv(path)
     else:
         pieces = load_spiece_model(path)
-    return SentencePieceTokenizer(pieces, add_eos=add_eos)
+    return SentencePieceTokenizer(pieces, add_eos=add_eos, add_bos=add_bos)

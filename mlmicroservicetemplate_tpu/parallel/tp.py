@@ -105,11 +105,39 @@ def gpt_param_spec(cfg):
     }
 
 
+def llama_param_spec(cfg):
+    """PartitionSpec pytree matching ``llama.init_params``: q/k/v +
+    gate/up column-parallel, o/down row-parallel, embeddings + lm_head
+    model/column-sharded, RMSNorm scales replicated.  k/v out dims are
+    num_kv_heads*head_dim, so tp must divide the KV width (4 heads on
+    TinyLlama ⇒ tp ≤ 4 there)."""
+    from jax.sharding import PartitionSpec as P
+
+    col = {"kernel": P(None, "tp")}
+    row = {"kernel": P("tp", None)}
+    ln = {"scale": P()}
+    return {
+        "embed": {"embedding": P(None, "tp")},
+        "layers": [
+            {
+                "attn_ln": ln,
+                "attn": {"q": col, "k": col, "v": col, "o": row},
+                "mlp_ln": ln,
+                "mlp": {"gate": col, "up": col, "down": row},
+            }
+            for _ in range(cfg.num_layers)
+        ],
+        "final_ln": ln,
+        "lm_head": {"kernel": P(None, "tp")},
+    }
+
+
 PARAM_SPECS = {
     # model-name prefix -> spec builder(cfg); used by the registry to
     # turn TP=<n> into a servable TensorParallelSet placement.
     "bert": bert_param_spec,
     "gpt": gpt_param_spec,
+    "llama": llama_param_spec,
 }
 
 
