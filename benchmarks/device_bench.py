@@ -295,6 +295,8 @@ def main() -> None:
                  "max_decode_len": int(os.environ.get("BENCH_DECODE_LEN", "64"))}
     if os.environ.get("DEVICE"):
         overrides["device"] = os.environ["DEVICE"]
+    if os.environ.get("QUANTIZE"):
+        overrides["quantize"] = os.environ["QUANTIZE"]
     cfg = ServiceConfig(**overrides)
     apply_device_env(cfg.device)
     bundle = build_model(cfg)

@@ -336,3 +336,65 @@ def test_padded_prefill_does_not_clobber_neighbor_slot():
         np.testing.assert_array_equal(got_b[:n], solo_b[:n])
     finally:
         cdl.stop()
+
+
+def test_dispatch_failure_errors_streams_and_recovers():
+    """A device-dispatch failure mid-decode must surface to the live
+    consumers as an error AND leave the loop serviceable: the shared
+    state rebuilds and a fresh stream completes."""
+    bundle = _echo_bundle()
+    cfg = _cfg(max_decode_len=16)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    real_chunk = eng._gen_chunk
+    boom = {"armed": False, "fired": False}
+
+    def flaky(p, state, n, sample):
+        if boom["armed"] and not boom["fired"]:
+            boom["fired"] = True
+            raise RuntimeError("injected relay failure")
+        return real_chunk(p, state, n, sample)
+
+    eng._gen_chunk = flaky
+    feats = text_feats(bundle.tokenizer, "long enough to need chunks!!")
+
+    async def body():
+        boom["armed"] = True
+        with pytest.raises(RuntimeError, match="injected relay failure"):
+            await _consume(cdl, dict(feats))
+        assert boom["fired"]
+        boom["armed"] = False
+        # Loop must have reset (state rebuilt lazily) and still serve.
+        for _ in range(100):
+            if cdl._admitted == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert cdl._admitted == 0, "failure path leaked an admission slot"
+        out = await _consume(cdl, dict(feats))
+        assert len(out) > 0
+
+    try:
+        asyncio.run(body())
+    finally:
+        cdl.stop()
+
+
+def test_continuous_batching_on_replica_mesh(cpu_devices):
+    """The shared decode loop composes with replica-DP serving: slot
+    count pads to the mesh width and tokens stay solo-identical."""
+    bundle = tiny_t5_bundle()
+    cfg = _cfg(max_decode_len=8, seq_buckets=(16, 32), max_streams=3)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(2)))
+    feats = [text_feats(bundle.tokenizer, t)
+             for t in ("summarize: alpha", "translate: beta")]
+    solos = [_solo_tokens(eng, f) for f in feats]
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    assert cdl.n_slots % 2 == 0  # padded to the replica multiple
+    try:
+        outs = _run_concurrent(cdl, feats)
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            np.testing.assert_array_equal(got[:n], want[:n])
+    finally:
+        cdl.stop()
