@@ -45,6 +45,7 @@ def _build(device: str):
         device=device, model_name=MODEL, warmup=False,
         batch_buckets=(1,), seq_buckets=(64,),
         max_decode_len=DECODE, stream_chunk_tokens=CHUNK, max_streams=max(LEVELS),
+        quantize=os.environ.get("QUANTIZE") or None,
     )
     bundle = build_model(cfg)
     eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
