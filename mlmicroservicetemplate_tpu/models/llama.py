@@ -161,17 +161,29 @@ def forward_hidden(
     attention_mask: jax.Array,  # [B, S]
     dtype=jnp.float32,
     collect_kv: bool = False,
+    prefix_kv=None,  # optional list[(k,v)] of [1, P, KVH, D] cached prefix
 ):
-    """Hidden states [B, S, D] (+ per-layer ROTATED prompt K / V)."""
+    """Hidden states [B, S, D] (+ per-layer ROTATED prompt K / V).
+
+    With ``prefix_kv`` the batch is the SUFFIX of a shared cached
+    prompt prefix: tokens take rotary positions P.., queries attend to
+    the (already rotated) prefix K/V plus the causal suffix — prefill
+    cost is O(S), not O(P+S)."""
     b, s = input_ids.shape
+    p_len = 0 if prefix_kv is None else prefix_kv[0][0].shape[1]
     x = embed(params["embed"], input_ids, dtype)
-    pos = jnp.arange(s, dtype=jnp.int32)
+    pos = jnp.arange(p_len, p_len + s, dtype=jnp.int32)
     cos, sin = _rope_tables(cfg, pos, dtype)  # [S, D_h]
     cos, sin = cos[None, :, None, :], sin[None, :, None, :]
     causal = jnp.tril(jnp.ones((s, s), bool))
     mask = causal[None, None] & (attention_mask[:, None, None, :] != 0)
+    if p_len:
+        pre = jnp.ones((1, 1, s, p_len), bool)  # prefix fully visible
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(pre, (b, 1, s, p_len)), mask], axis=-1
+        )
     kv = []
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
         q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
@@ -179,6 +191,14 @@ def forward_hidden(
         v = _split(dense(a["v"], h), cfg.num_kv_heads)
         if collect_kv:
             kv.append((k, v))
+        if p_len:
+            pk, pv = prefix_kv[li]
+            k = jnp.concatenate(
+                [jnp.broadcast_to(pk.astype(k.dtype), (b,) + pk.shape[1:]), k], axis=1
+            )
+            v = jnp.concatenate(
+                [jnp.broadcast_to(pv.astype(v.dtype), (b,) + pv.shape[1:]), v], axis=1
+            )
         ctx = mha_attention(
             q, _repeat_kv(k, cfg.n_rep), _repeat_kv(v, cfg.n_rep), mask=mask
         )
@@ -188,6 +208,16 @@ def forward_hidden(
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
     x = rmsnorm(params["final_ln"], x, eps=cfg.rms_eps)
     return (x, kv) if collect_kv else x
+
+
+def compute_prefix_kv(params: Params, cfg: LlamaConfig, prefix_ids, dtype=jnp.float32):
+    """Per-layer ROTATED K/V of a shared prompt prefix — computed once
+    at startup, carried in params under ``__prefix__`` (see gpt.py)."""
+    ids = jnp.asarray(prefix_ids, jnp.int32).reshape(1, -1)
+    _, kv = forward_hidden(
+        params, cfg, ids, jnp.ones_like(ids), dtype, collect_kv=True
+    )
+    return {"k": [k for k, _ in kv], "v": [v for _, v in kv]}
 
 
 def lm_logits(
@@ -214,17 +244,29 @@ def init_decode_state(
     from .sampling import greedy_params
 
     b, s = input_ids.shape
-    total = s + max_len
+    pre = params.get("__prefix__") if isinstance(params, dict) else None
+    p_len = pre["k"][0].shape[1] if pre is not None else 0
+    prefix_kv = list(zip(pre["k"], pre["v"])) if pre is not None else None
+    total = p_len + s + max_len
     _, kv = forward_hidden(
-        params, cfg, input_ids, attention_mask, dtype, collect_kv=True
+        params, cfg, input_ids, attention_mask, dtype,
+        collect_kv=True, prefix_kv=prefix_kv,
     )
     cache_k, cache_v = [], []
-    for k, v in kv:
+    for li, (k, v) in enumerate(kv):
         ck = jnp.zeros((b, total, cfg.num_kv_heads, cfg.head_dim), k.dtype)
-        cache_k.append(ck.at[:, :s].set(k))
-        cache_v.append(ck.at[:, :s].set(v))
+        cv = ck
+        if p_len:
+            pk, pv = prefix_kv[li]
+            ck = ck.at[:, :p_len].set(pk.astype(ck.dtype))
+            cv = cv.at[:, :p_len].set(pv.astype(cv.dtype))
+        cache_k.append(ck.at[:, p_len : p_len + s].set(k))
+        cache_v.append(cv.at[:, p_len : p_len + s].set(v))
     lengths = attention_mask.sum(axis=-1).astype(jnp.int32)
-    key_valid = jnp.zeros((b, total), jnp.int32).at[:, :s].set(
+    key_valid = jnp.zeros((b, total), jnp.int32)
+    if p_len:
+        key_valid = key_valid.at[:, :p_len].set(1)
+    key_valid = key_valid.at[:, p_len : p_len + s].set(
         attention_mask.astype(jnp.int32)
     )
     rows = jnp.arange(b)
@@ -233,7 +275,7 @@ def init_decode_state(
         cache_k=cache_k,
         cache_v=cache_v,
         key_valid=key_valid,
-        write_idx=jnp.maximum(lengths - 1, 0),
+        write_idx=p_len + jnp.maximum(lengths - 1, 0),
         pos=jnp.zeros((b,), jnp.int32),
         last_token=last_tok.astype(jnp.int32),
         done=lengths == 0,

@@ -57,6 +57,9 @@ class ModelBundle:
     # leave position-table room for generation — jnp.take would clamp
     # out-of-range positions silently otherwise).
     max_prompt_len: int | None = None
+    # Whether this family consumed cfg.prompt_prefix (cached system-
+    # prompt KV); build_model rejects the knob when unsupported.
+    supports_prefix: bool = False
 
     # -- host-side single-item pre/post ------------------------------------
     def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
@@ -153,6 +156,43 @@ def _maybe_quantize(params, svc_cfg):
     from .quant import quantize_pytree
 
     return quantize_pytree(params, mode)
+
+
+def _attach_prompt_prefix(params, tokenizer, svc_cfg, compute_fn,
+                          max_positions: int) -> int:
+    """Cache a shared system-prompt prefix's KV into the params pytree
+    (``__prefix__``) — computed once here (one jitted dispatch), then
+    placed/sharded/traced like weights.  Returns the prefix token count
+    (0 = no prefix configured)."""
+    prefix = getattr(svc_cfg, "prompt_prefix", None)
+    if not prefix:
+        return 0
+    if int(getattr(svc_cfg, "tp", 0) or 0) > 1:
+        raise ValueError(
+            "PROMPT_PREFIX and TP cannot combine yet (the TP param spec "
+            "does not cover the cached prefix KV subtree)"
+        )
+    import jax
+
+    ids, mask = tokenizer.encode(prefix, max_positions)
+    n = int(mask.sum())
+    # The request tokenizer may append terminal specials (byte/SP
+    # fallbacks add eos; WordPiece adds [SEP]).  Baked into the MIDDLE
+    # of every served context, an EOS acts as a document separator and
+    # severs the prefix from the prompt — strip terminal specials, keep
+    # any leading BOS.
+    terminal = {
+        int(t) for t in (
+            getattr(tokenizer, "eos_id", None), getattr(tokenizer, "sep_id", None)
+        ) if t is not None
+    }
+    while n > 0 and int(ids[n - 1]) in terminal:
+        n -= 1
+    if n == 0:
+        raise ValueError("PROMPT_PREFIX tokenized to zero (non-special) tokens")
+    params["__prefix__"] = jax.jit(compute_fn)(params, ids[:n])
+    log.info("cached prompt prefix: %d tokens", n)
+    return n
 
 
 def _tp_placement(svc_cfg, model_cfg, family: str):
@@ -425,26 +465,37 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     params = cast_pytree(params, policy.param_jnp)
     params = _maybe_quantize(params, svc_cfg)
 
-    # Decode positions run to prompt_len + max_decode_len; jnp.take
-    # CLAMPS past the wpe table (silently wrong logits), so (a) the
-    # seq buckets must leave decode headroom and (b) prompts are capped
-    # below it at preprocess time. Engine rounds the decode budget up
-    # to a whole number of stream chunks — mirror that here.
+    # Optional shared system prompt: cached KV in the params pytree.
+    p_len = _attach_prompt_prefix(
+        params, tokenizer, svc_cfg,
+        lambda p, ids: gpt_mod.compute_prefix_kv(
+            p, cfg, ids, dtype=policy.compute_jnp
+        ),
+        cfg.max_position,
+    )
+
+    # Decode positions run to prefix + prompt_len + max_decode_len;
+    # jnp.take CLAMPS past the wpe table (silently wrong logits), so
+    # (a) the seq buckets must leave decode headroom and (b) prompts
+    # are capped below it at preprocess time. Engine rounds the decode
+    # budget up to a whole number of stream chunks — mirror that here.
     import math as _math
 
     chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
     decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
-    if decode_budget >= cfg.max_position:
+    if decode_budget + p_len >= cfg.max_position:
         raise ValueError(
-            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} leaves no room "
-            f"for a prompt within gpt2's {cfg.max_position} positions"
+            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} plus prefix "
+            f"{p_len} leaves no room for a prompt within gpt2's "
+            f"{cfg.max_position} positions"
         )
-    max_prompt = cfg.max_position - decode_budget
+    max_prompt = cfg.max_position - decode_budget - p_len
     bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
     if bad:
         raise ValueError(
             f"SEQ_BUCKETS {bad} exceed gpt2's position budget: max prompt = "
-            f"{cfg.max_position} positions - {decode_budget} decode = {max_prompt}"
+            f"{cfg.max_position} - {decode_budget} decode - {p_len} prefix "
+            f"= {max_prompt}"
         )
 
     def encode_fn(p, input_ids, attention_mask):
@@ -476,6 +527,7 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         max_prompt_len=max_prompt,
         # TP=<n>: decoder Megatron sharding (parallel/tp.py gpt spec).
         make_placement=_tp_placement(svc_cfg, cfg, "gpt"),
+        supports_prefix=True,
     )
 
 
@@ -537,21 +589,35 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     params = cast_pytree(params, policy.param_jnp)
     params = _maybe_quantize(params, svc_cfg)
 
-    # Same position-budget arithmetic as gpt2: decode must fit inside
-    # max_position after the prompt bucket.
+    # Optional shared system prompt (cached KV).  The prefix carries
+    # the BOS; request suffixes must then NOT get their own.
+    p_len = _attach_prompt_prefix(
+        params, tokenizer, svc_cfg,
+        lambda p, ids: llama_mod.compute_prefix_kv(
+            p, cfg, ids, dtype=policy.compute_jnp
+        ),
+        cfg.max_position,
+    )
+    if p_len and getattr(tokenizer, "add_bos", False):
+        tokenizer.add_bos = False
+
+    # Same position-budget arithmetic as gpt2: prefix + prompt + decode
+    # must fit inside max_position.
     chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
     decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
-    if decode_budget >= cfg.max_position:
+    if decode_budget + p_len >= cfg.max_position:
         raise ValueError(
-            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} leaves no room "
-            f"for a prompt within llama's {cfg.max_position} positions"
+            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} plus prefix "
+            f"{p_len} leaves no room for a prompt within llama's "
+            f"{cfg.max_position} positions"
         )
-    max_prompt = cfg.max_position - decode_budget
+    max_prompt = cfg.max_position - decode_budget - p_len
     bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
     if bad:
         raise ValueError(
             f"SEQ_BUCKETS {bad} exceed llama's position budget: max prompt = "
-            f"{cfg.max_position} - {decode_budget} decode = {max_prompt}"
+            f"{cfg.max_position} - {decode_budget} decode - {p_len} prefix "
+            f"= {max_prompt}"
         )
 
     def encode_fn(p, input_ids, attention_mask):
@@ -580,6 +646,7 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         generate_chunk_fn=generate_chunk_fn,
         max_prompt_len=max_prompt,
         make_placement=_tp_placement(svc_cfg, cfg, "llama"),
+        supports_prefix=True,
     )
 
 
@@ -635,7 +702,14 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
     if int(getattr(svc_cfg, "tp", 0) or 0) > 1 and bundle.make_placement is None:
         raise ValueError(
             f"TP={svc_cfg.tp} is not supported for {svc_cfg.model_name!r} "
-            "(tensor-parallel serving covers bert-base and gpt2; bert-long "
-            "scales via SP/REPLICAS instead)"
+            "(tensor-parallel serving covers bert-base, gpt2 and llama; "
+            "bert-long scales via SP/REPLICAS instead)"
+        )
+    # A configured PROMPT_PREFIX that a model silently drops would serve
+    # un-prefixed generations with no warning — reject instead.
+    if getattr(svc_cfg, "prompt_prefix", None) and not bundle.supports_prefix:
+        raise ValueError(
+            f"PROMPT_PREFIX is not supported for {svc_cfg.model_name!r} "
+            "(cached-prefix serving covers the decoder families: gpt2, llama)"
         )
     return bundle

@@ -100,6 +100,12 @@ class ServiceConfig(BaseModel):
     # step — the lever for HBM-bound small-batch generation).
     quantize: str | None = None
 
+    # Shared prompt prefix (system prompt) for decoder models
+    # (gpt2/llama): its KV is computed ONCE at startup and cached, so
+    # every request's prefill pays only its own suffix (O(S) instead
+    # of O(P+S)) and the prefix never counts against wire bytes.
+    prompt_prefix: str | None = None
+
     # Observability.
     log_level: str = "INFO"
 
@@ -143,7 +149,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, SP, TP,
       MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
       MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS, QUANTIZE,
-      REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING.
+      REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX.
     """
     e = dict(os.environ)
     if env:
@@ -163,6 +169,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "server_url": "SERVER_URL",
         "log_level": "LOG_LEVEL",
         "quantize": "QUANTIZE",
+        "prompt_prefix": "PROMPT_PREFIX",
     }
     for field, var in mapping.items():
         v = get(var)
