@@ -1,0 +1,90 @@
+"""PROMPT_PREFIX A/B: prefill cost with a cached system prompt vs
+re-encoding it in every request.
+
+Measures the fused prefill+first-chunk dispatch (the TTFT dispatch)
+for a short user suffix under three configurations:
+  a) no prefix        — suffix-only baseline (the floor)
+  b) cached prefix    — PROMPT_PREFIX path: prefill sees only the suffix
+  c) concat prompt    — the prefix tokens prepended to every request
+                        (what you pay without the cache)
+
+(b) should sit at (a)'s cost regardless of prefix length; (c) grows
+with it.  Device time via the two-scan-length method (timing.py).
+
+    PREFIX_TOKENS=256 python benchmarks/prefix_ab.py     # TPU
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+PREFIX_TOKENS = int(os.environ.get("PREFIX_TOKENS", "256"))
+SUFFIX_TOKENS = int(os.environ.get("SUFFIX_TOKENS", "16"))
+CHUNK = 4
+DECODE = 16
+
+
+def main() -> None:
+    device = os.environ.get("DEVICE", "tpu")
+    from mlmicroservicetemplate_tpu.runtime.device import apply_device_env
+
+    apply_device_env(device)
+
+    import jax
+
+    from timing import device_time_per_call
+
+    model = os.environ.get("MODEL_NAME", "gpt2")
+    if model == "llama":
+        from mlmicroservicetemplate_tpu.models import llama as gpt_mod
+
+        cfg = gpt_mod.LlamaConfig()
+    else:
+        from mlmicroservicetemplate_tpu.models import gpt as gpt_mod
+
+        cfg = gpt_mod.GPTConfig()
+    params = gpt_mod.init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree.map(
+        lambda x: x.astype("bfloat16") if x.dtype.kind == "f" else x, params
+    )
+    rng = np.random.default_rng(0)
+    prefix_ids = rng.integers(3, cfg.vocab_size, PREFIX_TOKENS).astype(np.int32)
+
+    cached = dict(params)
+    cached["__prefix__"] = jax.jit(
+        lambda p, ids: gpt_mod.compute_prefix_kv(p, cfg, ids, dtype="bfloat16")
+    )(params, prefix_ids)
+
+    def start(p, ids, mask):
+        state = gpt_mod.init_decode_state(p, cfg, ids, mask, DECODE, dtype="bfloat16")
+        _, toks = gpt_mod.generate_chunk(p, cfg, state, CHUNK)
+        return toks
+
+    def prefill_ms(p, n_tokens: int) -> tuple[float, bool]:
+        ids = rng.integers(3, cfg.vocab_size, (1, n_tokens)).astype(np.int32)
+        mask = np.ones((1, n_tokens), np.int32)
+        dt, noisy = device_time_per_call(start, (p, ids, mask), carry_idx=1,
+                                         iters=int(os.environ.get("PREFIX_SCAN_ITERS", "24")))
+        return round(dt * 1000, 3), noisy
+
+    a, a_noisy = prefill_ms(params, SUFFIX_TOKENS)
+    b, b_noisy = prefill_ms(cached, SUFFIX_TOKENS)
+    c, c_noisy = prefill_ms(params, PREFIX_TOKENS + SUFFIX_TOKENS)
+    print(json.dumps({
+        "model": model, "prefix_tokens": PREFIX_TOKENS,
+        "suffix_tokens": SUFFIX_TOKENS, "device": device,
+        "no_prefix_ms": a, "cached_prefix_ms": b, "concat_prompt_ms": c,
+        "cached_vs_concat_speedup": round(c / b, 2),
+        "noisy": {"a": a_noisy, "b": b_noisy, "c": c_noisy},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -195,6 +195,33 @@ def _attach_prompt_prefix(params, tokenizer, svc_cfg, compute_fn,
     return n
 
 
+def _decode_position_budget(svc_cfg, max_position: int, p_len: int,
+                            family: str) -> int:
+    """Shared decoder-position arithmetic: prefix + prompt + decode must
+    fit inside ``max_position`` (jnp.take would silently clamp past it).
+    Returns the max prompt length; raises when the budget is impossible
+    or a configured seq bucket exceeds it."""
+    import math as _math
+
+    chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
+    decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
+    if decode_budget + p_len >= max_position:
+        raise ValueError(
+            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} plus prefix "
+            f"{p_len} leaves no room for a prompt within {family}'s "
+            f"{max_position} positions"
+        )
+    max_prompt = max_position - decode_budget - p_len
+    bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
+    if bad:
+        raise ValueError(
+            f"SEQ_BUCKETS {bad} exceed {family}'s position budget: max "
+            f"prompt = {max_position} - {decode_budget} decode - {p_len} "
+            f"prefix = {max_prompt}"
+        )
+    return max_prompt
+
+
 def _tp_placement(svc_cfg, model_cfg, family: str):
     """TP=<n> → a TensorParallelSet factory over a ('replica','tp')
     mesh with the family's Megatron param spec; None when TP is off.
@@ -474,29 +501,7 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         cfg.max_position,
     )
 
-    # Decode positions run to prefix + prompt_len + max_decode_len;
-    # jnp.take CLAMPS past the wpe table (silently wrong logits), so
-    # (a) the seq buckets must leave decode headroom and (b) prompts
-    # are capped below it at preprocess time. Engine rounds the decode
-    # budget up to a whole number of stream chunks — mirror that here.
-    import math as _math
-
-    chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
-    decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
-    if decode_budget + p_len >= cfg.max_position:
-        raise ValueError(
-            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} plus prefix "
-            f"{p_len} leaves no room for a prompt within gpt2's "
-            f"{cfg.max_position} positions"
-        )
-    max_prompt = cfg.max_position - decode_budget - p_len
-    bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
-    if bad:
-        raise ValueError(
-            f"SEQ_BUCKETS {bad} exceed gpt2's position budget: max prompt = "
-            f"{cfg.max_position} - {decode_budget} decode - {p_len} prefix "
-            f"= {max_prompt}"
-        )
+    max_prompt = _decode_position_budget(svc_cfg, cfg.max_position, p_len, "gpt2")
 
     def encode_fn(p, input_ids, attention_mask):
         # Prompt passes through; the prefill forward happens in
@@ -541,7 +546,6 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     builder serves the whole dims family without code changes.
     """
     import json as _json
-    import math as _math
     import os as _os
 
     from ..convert import llama_state_to_pytree
@@ -601,24 +605,7 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     if p_len and getattr(tokenizer, "add_bos", False):
         tokenizer.add_bos = False
 
-    # Same position-budget arithmetic as gpt2: prefix + prompt + decode
-    # must fit inside max_position.
-    chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
-    decode_budget = int(_math.ceil(svc_cfg.max_decode_len / chunk) * chunk)
-    if decode_budget + p_len >= cfg.max_position:
-        raise ValueError(
-            f"MAX_DECODE_LEN(+chunk rounding)={decode_budget} plus prefix "
-            f"{p_len} leaves no room for a prompt within llama's "
-            f"{cfg.max_position} positions"
-        )
-    max_prompt = cfg.max_position - decode_budget - p_len
-    bad = [s for s in svc_cfg.seq_buckets if s > max_prompt]
-    if bad:
-        raise ValueError(
-            f"SEQ_BUCKETS {bad} exceed llama's position budget: max prompt = "
-            f"{cfg.max_position} - {decode_budget} decode - {p_len} prefix "
-            f"= {max_prompt}"
-        )
+    max_prompt = _decode_position_budget(svc_cfg, cfg.max_position, p_len, "llama")
 
     def encode_fn(p, input_ids, attention_mask):
         return input_ids
