@@ -87,6 +87,19 @@ class ReplicaSet:
         computation — no explicit in_shardings needed."""
         import jax
 
+        if jax.process_count() > 1:
+            # Host-local numpy cannot device_put onto non-addressable
+            # devices; multi-host SERVING additionally needs every
+            # process to enter the SPMD computation in lockstep (a
+            # driver pattern this single-controller HTTP path does not
+            # implement).  The multi-host bootstrap currently serves
+            # the training/collective machinery — fail loudly here.
+            raise NotImplementedError(
+                "multi-process serving data-path is not implemented: the "
+                "HTTP batcher is single-controller; run one serving "
+                "process per host (REPLICAS over local devices) or use "
+                "the train-step path for cross-host meshes"
+            )
         placed = tuple(jax.device_put(a, self.batch_sharding) for a in arrays)
         return placed if len(placed) != 1 else placed[0]
 

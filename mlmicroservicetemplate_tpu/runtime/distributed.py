@@ -1,0 +1,74 @@
+"""Multi-host runtime initialization (the DCN story).
+
+Single-host serving needs nothing from this module: a v5e-8's eight
+chips share one host and ICI, and the mesh code (``parallel/``) already
+spans them.  On MULTI-host topologies (v5e-16+, pods), JAX processes
+must rendezvous before any device use so the global device list covers
+every host and XLA can emit DCN collectives between ICI islands — the
+TPU-native answer to the reference stack's multi-node NCCL/MPI
+bootstrap (SURVEY.md §5 "Distributed communication backend"), with the
+same division of labor: this module only BOOTSTRAPS; the collectives
+themselves are compiled by XLA, never hand-written.
+
+Env contract (standard jax.distributed args, all-or-nothing):
+  JAX_COORDINATOR      host:port of process 0 (e.g. "10.0.0.2:8476")
+  JAX_NUM_PROCESSES    total process count
+  JAX_PROCESS_ID       this process's index [0, NUM_PROCESSES)
+
+Unset ⇒ single-host, no-op.  ``serve.build_service`` calls this before
+the platform probe; meshes built afterwards see ``jax.devices()``
+spanning all hosts, and ``parallel/``'s NamedShardings lay axes out so
+collectives ride ICI within a host and DCN only across (device order
+groups by process).
+
+Scope: this bootstraps the RUNTIME (cross-host meshes for the
+train-step/collective machinery).  The HTTP serving data path stays
+single-controller — ``ReplicaSet.place_batch`` refuses multi-process
+placement loudly — so pods serve as one process per host with
+``REPLICAS`` over the local chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_ENV = ("JAX_COORDINATOR", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
+
+
+def maybe_init_distributed(env: dict | None = None) -> bool:
+    """Rendezvous this process into a multi-host JAX runtime when the
+    JAX_COORDINATOR/… env trio is set; no-op (False) otherwise.
+
+    MUST run before the first device use (same latch as platform
+    selection — runtime.device.apply_device_env).  Raises on a partial
+    env (a half-configured pod must not silently serve single-host).
+    """
+    e = env if env is not None else os.environ
+    present = [k for k in _ENV if e.get(k)]
+    if not present:
+        return False
+    missing = [k for k in _ENV if not e.get(k)]
+    if missing:
+        raise ValueError(
+            f"multi-host init needs all of {_ENV}; set {present} but not "
+            f"{missing} — a partially configured pod must fail loudly, not "
+            "serve single-host"
+        )
+    coordinator = e["JAX_COORDINATOR"]
+    num = int(e["JAX_NUM_PROCESSES"])
+    pid = int(e["JAX_PROCESS_ID"])
+    if not (0 <= pid < num):
+        raise ValueError(f"JAX_PROCESS_ID={pid} outside [0, {num})")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=num, process_id=pid
+    )
+    log.info(
+        "multi-host runtime up: process %d/%d via %s (%d global devices)",
+        pid, num, coordinator, len(jax.devices()),
+    )
+    return True

@@ -51,6 +51,13 @@ def build_service(overrides: dict | None = None):
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
 
+    # Multi-host rendezvous (JAX_COORDINATOR/NUM_PROCESSES/PROCESS_ID;
+    # no-op single-host) — must precede apply_device_env, whose backend
+    # probe would latch initialization before the processes rendezvous.
+    from .runtime.distributed import maybe_init_distributed
+
+    maybe_init_distributed()
+
     from .runtime.device import apply_device_env
 
     apply_device_env(cfg.device)

@@ -56,3 +56,20 @@ def test_compilation_cache_gating(tmp_path, monkeypatch):
     assert enable_compilation_cache("tpu") is None
     monkeypatch.delenv("COMPILE_CACHE_DIR")
     assert enable_compilation_cache("cpu") is None
+
+
+def test_multi_host_init_gating():
+    """maybe_init_distributed: no-op without env, loud on partial env,
+    bounds-checked process id."""
+    import pytest
+
+    from mlmicroservicetemplate_tpu.runtime.distributed import maybe_init_distributed
+
+    assert maybe_init_distributed(env={}) is False
+    with pytest.raises(ValueError, match="fail loudly"):
+        maybe_init_distributed(env={"JAX_COORDINATOR": "h:1"})
+    with pytest.raises(ValueError, match="outside"):
+        maybe_init_distributed(env={
+            "JAX_COORDINATOR": "h:1", "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": "5",
+        })
