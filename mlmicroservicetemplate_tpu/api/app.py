@@ -146,9 +146,28 @@ async def _parse_request(request: web.Request) -> RawItem:
             )
         if seed is not None and not (0 <= seed < 2**32):
             raise web.HTTPBadRequest(reason="seed must be in [0, 2**32)")
+        try:
+            max_tokens = body.get("max_tokens")
+            max_tokens = int(max_tokens) if max_tokens is not None else None
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(reason="max_tokens must be an integer")
+        if max_tokens is not None and max_tokens < 1:
+            raise web.HTTPBadRequest(reason="max_tokens must be >= 1")
+        stop = body.get("stop")
+        if stop is None:  # JSON null == absent (schema-generated clients)
+            stop = ()
+        if isinstance(stop, str):
+            stop = (stop,)
+        if not isinstance(stop, (list, tuple)) or len(stop) > 8 or not all(
+            isinstance(s, str) and s for s in stop
+        ):
+            raise web.HTTPBadRequest(
+                reason='"stop" must be a non-empty string or a list of up to 8'
+            )
         return RawItem(
             text=text, stream=stream, temperature=temperature,
             top_k=top_k, top_p=top_p, seed=seed,
+            max_tokens=max_tokens, stop=tuple(stop),
         )
     if ctype.startswith("multipart/"):
         reader = await request.multipart()
@@ -193,13 +212,19 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
         raise web.HTTPBadRequest(reason=str(e) or "undecodable payload")
 
     if stream and bundle.kind == KIND_SEQ2SEQ:
-        return await _stream_predict(request, feats, t0)
+        return await _stream_predict(request, feats, t0, item)
 
     try:
         row = await app["batcher"].submit(feats)
+        if bundle.kind == KIND_SEQ2SEQ and item.max_tokens is not None:
+            row = row[: item.max_tokens]
         # Postprocess sits inside the same try: EVERY terminal status on
         # /predict increments REQUESTS, including a postprocess crash.
         result = await loop.run_in_executor(None, bundle.postprocess, row)
+        if bundle.kind == KIND_SEQ2SEQ and item.stop:
+            result["prediction"]["text"] = _apply_stop(
+                result["prediction"]["text"], item.stop
+            )
     except QueueFullError:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
         raise web.HTTPServiceUnavailable(reason="batch queue full, retry later")
@@ -217,8 +242,31 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
     return web.json_response(result)
 
 
+def _apply_stop(text: str, stops) -> str:
+    """Truncate at the FIRST occurrence of any stop string."""
+    cut = len(text)
+    for s in stops:
+        i = text.find(s)
+        if i != -1:
+            cut = min(cut, i)
+    return text[:cut]
+
+
+def _stop_holdback(text: str, stops) -> int:
+    """Chars to withhold from streaming: the longest suffix of ``text``
+    that is a strict prefix of some stop string — it may complete into
+    a stop next chunk, and an emitted delta cannot be retracted."""
+    hb = 0
+    for s in stops:
+        for k in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:k]):
+                hb = max(hb, k)
+                break
+    return hb
+
+
 async def _stream_predict(
-    request: web.Request, feats: dict, t0: float
+    request: web.Request, feats: dict, t0: float, item: RawItem
 ) -> web.StreamResponse:
     """Chunked seq2seq streaming: ndjson lines of decoded-token deltas."""
     app = request.app
@@ -239,6 +287,7 @@ async def _stream_predict(
     tokens: list[int] = []
     prev_text = ""
     decode_steps = 0
+    finished = False
     try:
         # On ANY exit — client disconnect mid-write included — close the
         # stream generator explicitly so the batcher's pump sees
@@ -249,17 +298,44 @@ async def _stream_predict(
             decode_steps += int(chunk.size)
             for t in chunk.tolist():
                 if t == eos:
+                    finished = True
+                    break
+                if item.max_tokens is not None and len(tokens) >= item.max_tokens:
+                    finished = True
                     break
                 if t != pad or not tokens:
                     tokens.append(int(t))
             # Decode cumulatively so multi-token pieces render correctly,
             # then emit only the new suffix.
             text = bundle.tokenizer.decode(np.array(tokens, np.int32))
+            if item.stop:
+                stopped = _apply_stop(text, item.stop)
+                if stopped != text:
+                    text = stopped
+                    finished = True
+                    # tokens_generated must not count past the stop:
+                    # keep the smallest token count whose decode covers
+                    # the truncated text.
+                    for n in range(len(tokens) + 1):
+                        if len(
+                            bundle.tokenizer.decode(np.array(tokens[:n], np.int32))
+                        ) >= len(text):
+                            tokens = tokens[:n]
+                            break
+                elif not finished:
+                    # Withhold any suffix that could complete into a
+                    # stop string next chunk — emitted deltas cannot be
+                    # retracted.
+                    text = text[: len(text) - _stop_holdback(text, item.stop)]
+            if len(text) < len(prev_text):
+                text = prev_text  # holdback may only grow the emission
             delta = text[len(prev_text):]
             prev_text = text
             # One line per device chunk even when the decoded delta is
             # empty: clients get progress/TTFT signal at chunk cadence.
             await resp.write((json.dumps({"delta": delta}) + "\n").encode())
+            if finished:
+                break  # the finally's aclose frees the slot at a boundary
         dt = time.monotonic() - t0
         await resp.write(
             (

@@ -103,7 +103,8 @@ class InferenceEngine:
             # while_loop of chunk scans, still ONE dispatch.  An
             # all-EOS batch exits at the next chunk boundary instead of
             # paying the full max_decode_len scan on the device.
-            def full(p, ids, mask, sp, max_len: int, chunk: int, sample: bool):
+            def full(p, ids, mask, sp, budgets, max_len: int, chunk: int,
+                     sample: bool):
                 import jax.numpy as jnp
                 from jax import lax
 
@@ -123,12 +124,15 @@ class InferenceEngine:
 
                 def body(s):
                     s, _ = bundle.generate_chunk_fn(p, s, chunk, sample)
-                    return s
+                    # Per-row max_tokens: a capped row counts as done so
+                    # an all-capped batch exits at the chunk boundary
+                    # instead of paying the full budget on the device.
+                    return s._replace(done=s.done | (s.pos >= budgets))
 
                 state = lax.while_loop(cond, body, state)
                 return state.tokens, state.pos.max()
 
-            self._full = jax.jit(full, static_argnums=(4, 5, 6))
+            self._full = jax.jit(full, static_argnums=(5, 6, 7))
         else:
             self._forward = jax.jit(bundle.forward)
         # Decode steps actually executed by the most recent non-streaming
@@ -198,6 +202,20 @@ class InferenceEngine:
                 seed[i] = np.uint32(s & 0xFFFFFFFF)
         return make_params(seed, temp, top_k, top_p), sampled
 
+    def budget_for(self, feats: dict) -> int:
+        """One stream's token budget: request max_tokens clamped to the
+        server decode budget (shared by both streaming paths)."""
+        return min(
+            int(feats.get("max_tokens", self.max_decode_len)), self.max_decode_len
+        )
+
+    def _collate_budget(self, feats: list[dict], bsz: int) -> np.ndarray:
+        """Per-row budgets for the batched non-stream path; pad rows 0."""
+        budgets = np.zeros(bsz, np.int32)
+        for i, f in enumerate(feats):
+            budgets[i] = self.budget_for(f)
+        return budgets
+
     # ------------------------------------------------------------------
     # dispatch
 
@@ -229,9 +247,10 @@ class InferenceEngine:
                 # init + done-aware chunked decode (early EOS exit)
                 ids, mask, n = self._collate_text(feats)
                 sp, sampled = self._collate_sample(feats, ids.shape[0])
+                budgets = self._collate_budget(feats, ids.shape[0])
                 ids, mask = self.replicas.place_batch(ids, mask)
                 tokens, steps = self._full(
-                    self.params, ids, mask, sp,
+                    self.params, ids, mask, sp, budgets,
                     self.max_decode_len, self.chunk_tokens, sampled,
                 )
                 # tokens + step count in ONE transfer (each device_get
@@ -266,11 +285,14 @@ class InferenceEngine:
             # relay round-trip, so never fetch them separately.
             toks_np, done_np = jax.device_get((toks, state.done))
             chunk, done = toks_np[0], bool(done_np[0])
+        # Request max_tokens bounds chunk spending (the API layer trims
+        # to the exact token count).
+        budget = self.budget_for(feats)
         produced = self.chunk_tokens
         yield chunk
         if done:
             return
-        while produced < self.max_decode_len:
+        while produced < budget:
             with self._lock:
                 state, toks = self._gen_chunk(
                     self.params, state, self.chunk_tokens, sampled

@@ -59,15 +59,22 @@ class StreamClosedError(Exception):
 class _Stream:
     """One client stream: thread-safe bridge loop-thread → event loop."""
 
-    __slots__ = ("feats", "chunks", "loop", "cancelled", "produced", "released")
+    __slots__ = (
+        "feats", "chunks", "loop", "cancelled", "produced", "released", "budget",
+    )
 
-    def __init__(self, feats: dict, loop: asyncio.AbstractEventLoop):
+    def __init__(self, feats: dict, loop: asyncio.AbstractEventLoop,
+                 budget: int):
         self.feats = feats
         self.chunks: asyncio.Queue = asyncio.Queue()
         self.loop = loop
         self.cancelled = threading.Event()
         self.produced = 0
         self.released = False  # loop-thread-owned: exactly-once release
+        # Token budget (request max_tokens clamped to the server's
+        # decode budget): the loop stops spending chunks on this row
+        # once reached; the API layer trims to the exact count.
+        self.budget = budget
 
     def emit(self, item: Any) -> None:
         try:
@@ -140,7 +147,9 @@ class ContinuousDecodeLoop:
                 f"{total} streams active >= max_streams={self.max_streams}"
             )
         self._admitted += 1
-        st = _Stream(feats, asyncio.get_running_loop())
+        st = _Stream(
+            feats, asyncio.get_running_loop(), self.engine.budget_for(feats)
+        )
         self.pending.put(st)
         self._ensure_thread()
 
@@ -322,7 +331,7 @@ class ContinuousDecodeLoop:
             st.produced = eng.chunk_tokens
             st.emit(toks_np[0])
             metrics.TOKENS.labels(eng.bundle.name).inc(int(toks_np[0].size))
-            if bool(done_np[0]) or st.produced >= eng.max_decode_len:
+            if bool(done_np[0]) or st.produced >= st.budget:
                 self._finish(st)
                 continue
             # Any failure from here (empty-state build OOM, insert
@@ -443,7 +452,7 @@ class ContinuousDecodeLoop:
             st.emit(toks_np[slot])
             metrics.TOKENS.labels(eng.bundle.name).inc(int(toks_np[slot].size))
             st.produced += eng.chunk_tokens
-            if bool(done_np[slot]) or st.produced >= eng.max_decode_len:
+            if bool(done_np[slot]) or st.produced >= st.budget:
                 st.emit(_END)
                 self._free_slot(slot)
 

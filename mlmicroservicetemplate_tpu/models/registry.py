@@ -77,12 +77,17 @@ class ModelBundle:
         ids, mask = self.tokenizer.encode(item.text, max_len)
         n = int(mask.sum())
         feats = {"input_ids": ids[:n], "length": np.int32(n)}
-        if self.kind == KIND_SEQ2SEQ and item.temperature > 0.0:
-            feats["temperature"] = float(item.temperature)
-            feats["top_k"] = int(item.top_k)
-            feats["top_p"] = float(item.top_p)
-            if item.seed is not None:
-                feats["seed"] = int(item.seed)
+        if self.kind == KIND_SEQ2SEQ:
+            if item.temperature > 0.0:
+                feats["temperature"] = float(item.temperature)
+                feats["top_k"] = int(item.top_k)
+                feats["top_p"] = float(item.top_p)
+                if item.seed is not None:
+                    feats["seed"] = int(item.seed)
+            if item.max_tokens is not None:
+                # Scheduler-visible budget: the decode loop stops
+                # spending chunks on a row once it is reached.
+                feats["max_tokens"] = int(item.max_tokens)
         return feats
 
     def postprocess(self, row: np.ndarray) -> dict:
@@ -127,6 +132,10 @@ class RawItem:
     top_k: int = 0
     top_p: float = 1.0
     seed: int | None = None
+    # Generation stops after this many tokens (None = the server's
+    # MAX_DECODE_LEN budget) or when any stop string appears.
+    max_tokens: int | None = None
+    stop: tuple[str, ...] = ()
 
 
 # ---------------------------------------------------------------------------

@@ -440,3 +440,79 @@ def test_registration_heartbeat_reregisters():
             await server.close()
 
     asyncio.run(main())
+
+
+def test_predict_max_tokens_and_stop():
+    """max_tokens caps the generation exactly (stream and non-stream)
+    and stop strings truncate at first occurrence; bad values are 400s."""
+
+    async def body(client):
+        # Non-stream with max_tokens: the returned text comes from a
+        # trimmed token row (tiny t5 has an untied random head, so it
+        # emits visible tokens).
+        r_full = await client.post("/predict", json={"text": "summarize: hello"})
+        r_capped = await client.post(
+            "/predict", json={"text": "summarize: hello", "max_tokens": 2}
+        )
+        assert r_capped.status == 200
+        full_text = (await r_full.json())["prediction"]["text"]
+        capped_text = (await r_capped.json())["prediction"]["text"]
+        assert len(capped_text) <= len(full_text)
+
+        # Stream with max_tokens=3: at most 3 tokens reported.
+        resp = await client.post(
+            "/predict",
+            json={"text": "summarize: hello", "stream": True, "max_tokens": 3},
+        )
+        assert resp.status == 200
+        lines = [json.loads(l) for l in (await resp.text()).strip().splitlines()]
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens_generated"] <= 3
+
+        # Stop string: truncate the non-stream text at its first char.
+        if full_text:
+            stop_ch = full_text[0]
+            r_stop = await client.post(
+                "/predict", json={"text": "summarize: hello", "stop": stop_ch}
+            )
+            assert (await r_stop.json())["prediction"]["text"] == ""
+
+        # Validation.
+        bad = await client.post("/predict", json={"text": "x", "max_tokens": 0})
+        assert bad.status == 400
+        bad = await client.post("/predict", json={"text": "x", "stop": [1]})
+        assert bad.status == 400
+
+    _run(tiny_t5_bundle, body)
+
+
+def test_stream_stop_deltas_consistent_and_device_budget():
+    """Streamed deltas with a stop string concatenate to EXACTLY the
+    final prediction.text (stop-prefix holdback — an emitted delta can
+    never be retracted), and a fully-capped non-stream batch exits the
+    device loop at the first chunk boundary."""
+
+    async def main(client, engine, batcher, app):
+        r = await client.post("/predict", json={"text": "summarize: hello"})
+        full_text = (await r.json())["prediction"]["text"]
+        if len(full_text) >= 2:
+            stop = full_text[1]  # fires after at least one emitted char
+            resp = await client.post(
+                "/predict",
+                json={"text": "summarize: hello", "stream": True, "stop": stop},
+            )
+            lines = [json.loads(l) for l in (await resp.text()).strip().splitlines()]
+            assert lines[-1]["done"] is True
+            deltas = "".join(l.get("delta", "") for l in lines[:-1])
+            assert deltas == lines[-1]["prediction"]["text"]
+            assert stop not in deltas
+
+        # Device-side budget: max_tokens=1 must stop the while_loop at
+        # the first chunk (4 steps), not the full budget (8).
+        r = await client.post(
+            "/predict", json={"text": "summarize: hello", "max_tokens": 1}
+        )
+        assert r.status == 200
+        assert engine.last_decode_steps == 4
+
+    _serve(tiny_t5_bundle, main)
