@@ -39,6 +39,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app["state"] = {"ready_error": None, "warmup_s": None, "tracing": False}
 
     app.router.add_post("/predict", handle_predict)
+    app.router.add_post("/v1/completions", handle_completions)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/status", handle_status)
@@ -125,50 +126,7 @@ async def _parse_request(request: web.Request) -> RawItem:
             raise web.HTTPBadRequest(reason="invalid JSON body")
         if not isinstance(body, dict):
             raise web.HTTPBadRequest(reason="JSON body must be an object")
-        text = body.get("text") or body.get("input")
-        if not isinstance(text, str) or not text:
-            raise web.HTTPBadRequest(reason='JSON body needs a non-empty "text" field')
-        stream = bool(body.get("stream", False))
-        # Sampling controls (generative models; greedy when absent).
-        try:
-            temperature = float(body.get("temperature", 0.0))
-            top_k = int(body.get("top_k", 0))
-            top_p = float(body.get("top_p", 1.0))
-            seed = body.get("seed")
-            seed = int(seed) if seed is not None else None
-        except (TypeError, ValueError):
-            raise web.HTTPBadRequest(
-                reason="temperature/top_p must be numbers, top_k/seed integers"
-            )
-        if temperature < 0 or not (0.0 < top_p <= 1.0) or top_k < 0:
-            raise web.HTTPBadRequest(
-                reason="need temperature >= 0, 0 < top_p <= 1, top_k >= 0"
-            )
-        if seed is not None and not (0 <= seed < 2**32):
-            raise web.HTTPBadRequest(reason="seed must be in [0, 2**32)")
-        try:
-            max_tokens = body.get("max_tokens")
-            max_tokens = int(max_tokens) if max_tokens is not None else None
-        except (TypeError, ValueError):
-            raise web.HTTPBadRequest(reason="max_tokens must be an integer")
-        if max_tokens is not None and max_tokens < 1:
-            raise web.HTTPBadRequest(reason="max_tokens must be >= 1")
-        stop = body.get("stop")
-        if stop is None:  # JSON null == absent (schema-generated clients)
-            stop = ()
-        if isinstance(stop, str):
-            stop = (stop,)
-        if not isinstance(stop, (list, tuple)) or len(stop) > 8 or not all(
-            isinstance(s, str) and s for s in stop
-        ):
-            raise web.HTTPBadRequest(
-                reason='"stop" must be a non-empty string or a list of up to 8'
-            )
-        return RawItem(
-            text=text, stream=stream, temperature=temperature,
-            top_k=top_k, top_p=top_p, seed=seed,
-            max_tokens=max_tokens, stop=tuple(stop),
-        )
+        return _parse_json_item(body)
     if ctype.startswith("multipart/"):
         reader = await request.multipart()
         async for part in reader:
@@ -188,6 +146,55 @@ async def _parse_request(request: web.Request) -> RawItem:
     if not data:
         raise web.HTTPBadRequest(reason="empty request body")
     return RawItem(image=data)
+
+
+def _parse_json_item(body: dict) -> RawItem:
+    """Validate a JSON /predict-shaped body into a RawItem (shared with
+    the /v1/completions translation; all failures are HTTPBadRequest)."""
+    text = body.get("text") or body.get("input")
+    if not isinstance(text, str) or not text:
+        raise web.HTTPBadRequest(reason='JSON body needs a non-empty "text" field')
+    stream = bool(body.get("stream", False))
+    # Sampling controls (generative models; greedy when absent).
+    try:
+        temperature = float(body.get("temperature") or 0.0)
+        top_k = int(body.get("top_k") or 0)
+        top_p = float(body.get("top_p") if body.get("top_p") is not None else 1.0)
+        seed = body.get("seed")
+        seed = int(seed) if seed is not None else None
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(
+            reason="temperature/top_p must be numbers, top_k/seed integers"
+        )
+    if temperature < 0 or not (0.0 < top_p <= 1.0) or top_k < 0:
+        raise web.HTTPBadRequest(
+            reason="need temperature >= 0, 0 < top_p <= 1, top_k >= 0"
+        )
+    if seed is not None and not (0 <= seed < 2**32):
+        raise web.HTTPBadRequest(reason="seed must be in [0, 2**32)")
+    try:
+        max_tokens = body.get("max_tokens")
+        max_tokens = int(max_tokens) if max_tokens is not None else None
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(reason="max_tokens must be an integer")
+    if max_tokens is not None and max_tokens < 1:
+        raise web.HTTPBadRequest(reason="max_tokens must be >= 1")
+    stop = body.get("stop")
+    if stop is None:  # JSON null == absent (schema-generated clients)
+        stop = ()
+    if isinstance(stop, str):
+        stop = (stop,)
+    if not isinstance(stop, (list, tuple)) or len(stop) > 8 or not all(
+        isinstance(s, str) and s for s in stop
+    ):
+        raise web.HTTPBadRequest(
+            reason='"stop" must be a non-empty string or a list of up to 8'
+        )
+    return RawItem(
+        text=text, stream=stream, temperature=temperature,
+        top_k=top_k, top_p=top_p, seed=seed,
+        max_tokens=max_tokens, stop=tuple(stop),
+    )
 
 
 async def handle_predict(request: web.Request) -> web.StreamResponse:
@@ -265,6 +272,77 @@ def _stop_holdback(text: str, stops) -> int:
     return hb
 
 
+async def _delta_stream(bundle: ModelBundle, stream_iter, item: RawItem):
+    """Shared token→text-delta machinery for BOTH streaming endpoints.
+
+    Yields ``{"delta": str}`` events, then exactly one final
+    ``{"done": True, "text", "tokens", "steps", "finish_reason"}``.
+    Guarantees: concatenated deltas == final text; stop strings never
+    appear in the output (prefix holdback — deltas are irrevocable —
+    with the held-back suffix flushed when the stream ends for another
+    reason); ``tokens`` never counts past a stop truncation;
+    finish_reason is "stop" (EOS or stop string) or "length"
+    (max_tokens / server decode budget).
+    """
+    eos, pad = bundle.cfg.eos_id, bundle.cfg.pad_id
+    tokens: list[int] = []
+    prev_text = ""
+    steps = 0
+    finished = False
+    reason = "length"  # stream exhausting its budget = truncation
+
+    def decode(toks: list[int]) -> str:
+        return bundle.tokenizer.decode(np.array(toks, np.int32))
+
+    async for chunk in stream_iter:
+        steps += int(chunk.size)
+        for t in chunk.tolist():
+            if t == eos:
+                finished, reason = True, "stop"
+                break
+            if item.max_tokens is not None and len(tokens) >= item.max_tokens:
+                finished, reason = True, "length"
+                break
+            if t != pad or not tokens:
+                tokens.append(int(t))
+        text = decode(tokens)
+        if item.stop:
+            stopped = _apply_stop(text, item.stop)
+            if stopped != text and len(stopped) >= len(prev_text):
+                text, finished, reason = stopped, True, "stop"
+                # tokens must not count past the truncation: keep the
+                # smallest count whose decode covers the final text.
+                for n in range(len(tokens) + 1):
+                    if len(decode(tokens[:n])) >= len(text):
+                        tokens = tokens[:n]
+                        break
+            elif not finished:
+                # Withhold any suffix that could complete into a stop
+                # string next chunk.  (A "stop" inside already-emitted
+                # text can only come from non-monotonic re-decodes of
+                # partial byte sequences — emitted deltas are
+                # irrevocable, so it is ignored above.)
+                text = text[: len(text) - _stop_holdback(text, item.stop)]
+        if len(text) < len(prev_text):
+            text = prev_text  # emission only ever grows
+        delta = text[len(prev_text):]
+        prev_text = text
+        yield {"delta": delta}
+        if finished:
+            break
+    if not finished and item.stop:
+        # Budget exhausted with a held-back suffix: it can no longer
+        # complete into a stop string — flush it.
+        text = _apply_stop(decode(tokens), item.stop)
+        if len(text) > len(prev_text):
+            yield {"delta": text[len(prev_text):]}
+            prev_text = text
+    yield {
+        "done": True, "text": prev_text, "tokens": len(tokens),
+        "steps": steps, "finish_reason": reason,
+    }
+
+
 async def _stream_predict(
     request: web.Request, feats: dict, t0: float, item: RawItem
 ) -> web.StreamResponse:
@@ -282,84 +360,185 @@ async def _stream_predict(
     )
     resp.enable_chunked_encoding()
     await resp.prepare(request)
-    eos = bundle.cfg.eos_id
-    pad = bundle.cfg.pad_id
-    tokens: list[int] = []
-    prev_text = ""
-    decode_steps = 0
-    finished = False
     try:
         # On ANY exit — client disconnect mid-write included — close the
         # stream generator explicitly so the batcher's pump sees
         # `cancelled` now, not whenever GC finalizes the generator; an
         # abandoned stream must stop dispatching device chunks at the
         # next boundary.
-        async for chunk in stream_iter:
-            decode_steps += int(chunk.size)
-            for t in chunk.tolist():
-                if t == eos:
-                    finished = True
-                    break
-                if item.max_tokens is not None and len(tokens) >= item.max_tokens:
-                    finished = True
-                    break
-                if t != pad or not tokens:
-                    tokens.append(int(t))
-            # Decode cumulatively so multi-token pieces render correctly,
-            # then emit only the new suffix.
-            text = bundle.tokenizer.decode(np.array(tokens, np.int32))
-            if item.stop:
-                stopped = _apply_stop(text, item.stop)
-                if stopped != text:
-                    text = stopped
-                    finished = True
-                    # tokens_generated must not count past the stop:
-                    # keep the smallest token count whose decode covers
-                    # the truncated text.
-                    for n in range(len(tokens) + 1):
-                        if len(
-                            bundle.tokenizer.decode(np.array(tokens[:n], np.int32))
-                        ) >= len(text):
-                            tokens = tokens[:n]
-                            break
-                elif not finished:
-                    # Withhold any suffix that could complete into a
-                    # stop string next chunk — emitted deltas cannot be
-                    # retracted.
-                    text = text[: len(text) - _stop_holdback(text, item.stop)]
-            if len(text) < len(prev_text):
-                text = prev_text  # holdback may only grow the emission
-            delta = text[len(prev_text):]
-            prev_text = text
-            # One line per device chunk even when the decoded delta is
-            # empty: clients get progress/TTFT signal at chunk cadence.
-            await resp.write((json.dumps({"delta": delta}) + "\n").encode())
-            if finished:
-                break  # the finally's aclose frees the slot at a boundary
-        dt = time.monotonic() - t0
-        await resp.write(
-            (
-                json.dumps(
-                    {
-                        "done": True,
-                        "prediction": {"text": prev_text},
-                        "tokens_generated": len(tokens),
-                        "decode_steps": decode_steps,
-                        "model": bundle.name,
-                        "timing_ms": round(dt * 1000.0, 3),
-                    }
+        async for ev in _delta_stream(bundle, stream_iter, item):
+            if "delta" in ev:
+                # One line per device chunk even when the decoded delta
+                # is empty: clients get progress at chunk cadence.
+                await resp.write(
+                    (json.dumps({"delta": ev["delta"]}) + "\n").encode()
                 )
-                + "\n"
-            ).encode()
-        )
-        metrics.REQUESTS.labels(bundle.name, "200").inc()
-        metrics.LATENCY.labels(bundle.name).observe(dt)
+                continue
+            dt = time.monotonic() - t0
+            await resp.write(
+                (
+                    json.dumps(
+                        {
+                            "done": True,
+                            "prediction": {"text": ev["text"]},
+                            "tokens_generated": ev["tokens"],
+                            "decode_steps": ev["steps"],
+                            "finish_reason": ev["finish_reason"],
+                            "model": bundle.name,
+                            "timing_ms": round(dt * 1000.0, 3),
+                        }
+                    )
+                    + "\n"
+                ).encode()
+            )
+            metrics.REQUESTS.labels(bundle.name, "200").inc()
+            metrics.LATENCY.labels(bundle.name).observe(dt)
     finally:
         await stream_iter.aclose()
         try:
             await resp.write_eof()
         except ConnectionError:
             pass  # client already gone; nothing left to finalize
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# /v1/completions — OpenAI-compatible alias over the same serving path
+
+
+async def handle_completions(request: web.Request) -> web.StreamResponse:
+    """Completions-API compatibility for generative models: the field
+    names OpenAI-style clients already speak (``prompt``/``max_tokens``/
+    ``temperature``/``top_p``/``stop``/``stream``), served by the exact
+    same batcher/engine path as ``/predict``.  Streaming uses SSE
+    (``data: {...}`` lines ending with ``data: [DONE]``)."""
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    if bundle.kind != KIND_SEQ2SEQ:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(
+            reason=f"{bundle.name} is not a generative model"
+        )
+    t0 = time.monotonic()
+    try:
+        body = await request.json()
+        assert isinstance(body, dict)
+    except Exception:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason="invalid JSON body")
+    prompt = body.get("prompt")
+    if isinstance(prompt, list):  # the API allows a singleton batch
+        prompt = prompt[0] if len(prompt) == 1 else None
+    if not isinstance(prompt, str) or not prompt:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason='"prompt" must be a non-empty string')
+    # Reuse /predict's JSON validation by translating the field names.
+    try:
+        item = _parse_json_item({
+            "text": prompt,
+            "stream": bool(body.get("stream", False)),
+            "temperature": body.get("temperature", 0.0),
+            "top_p": body.get("top_p", 1.0),
+            "seed": body.get("seed"),
+            "max_tokens": body.get("max_tokens"),
+            "stop": body.get("stop"),
+        })
+    except web.HTTPBadRequest:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise
+    loop = asyncio.get_running_loop()
+    try:
+        feats = await loop.run_in_executor(None, bundle.preprocess, item)
+    except (ValueError, OSError) as e:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=str(e) or "bad prompt")
+
+    if item.stream:
+        return await _sse_completions(request, feats, item, t0)
+
+    try:
+        row = await app["batcher"].submit(feats)
+        full_len = int(np.count_nonzero(np.asarray(row) != bundle.cfg.pad_id))
+        if item.max_tokens is not None:
+            row = row[: item.max_tokens]
+        result = await loop.run_in_executor(None, bundle.postprocess, row)
+        text = result["prediction"]["text"]
+        stopped_by_string = False
+        if item.stop:
+            cut = _apply_stop(text, item.stop)
+            stopped_by_string = cut != text
+            text = cut
+        finish = "stop" if (
+            stopped_by_string
+            or item.max_tokens is None
+            or full_len <= item.max_tokens
+        ) else "length"
+    except QueueFullError:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise web.HTTPServiceUnavailable(reason="queue full, retry later")
+    except Exception:
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception("completion failed")
+        raise web.HTTPInternalServerError(reason="inference failed")
+    metrics.REQUESTS.labels(bundle.name, "200").inc()
+    metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+    return web.json_response({
+        "object": "text_completion",
+        "model": bundle.name,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish}],
+    })
+
+
+async def _sse_completions(
+    request: web.Request, feats: dict, item: RawItem, t0: float
+) -> web.StreamResponse:
+    """SSE streaming in the completions shape, bridged off the same
+    ndjson machinery as /predict (tokens → cumulative decode → deltas
+    with stop holdback)."""
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    try:
+        stream_iter = app["batcher"].submit_stream(feats)
+    except QueueFullError:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise web.HTTPServiceUnavailable(reason="too many active streams")
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "text/event-stream",
+                 "Cache-Control": "no-cache", "X-Accel-Buffering": "no"},
+    )
+    resp.enable_chunked_encoding()
+    await resp.prepare(request)
+
+    def sse(payload: dict) -> bytes:
+        return (f"data: {json.dumps(payload)}\n\n").encode()
+
+    try:
+        async for ev in _delta_stream(bundle, stream_iter, item):
+            if "delta" in ev:
+                if ev["delta"]:
+                    await resp.write(sse({
+                        "object": "text_completion",
+                        "model": bundle.name,
+                        "choices": [{"index": 0, "text": ev["delta"],
+                                     "finish_reason": None}],
+                    }))
+                continue
+            await resp.write(sse({
+                "object": "text_completion",
+                "model": bundle.name,
+                "choices": [{"index": 0, "text": "",
+                             "finish_reason": ev["finish_reason"]}],
+            }))
+            await resp.write(b"data: [DONE]\n\n")
+            metrics.REQUESTS.labels(bundle.name, "200").inc()
+            metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+    finally:
+        await stream_iter.aclose()
+        try:
+            await resp.write_eof()
+        except ConnectionError:
+            pass
     return resp
 
 

@@ -468,6 +468,7 @@ def test_predict_max_tokens_and_stop():
         lines = [json.loads(l) for l in (await resp.text()).strip().splitlines()]
         assert lines[-1]["done"] is True
         assert lines[-1]["tokens_generated"] <= 3
+        assert lines[-1]["finish_reason"] == "length"
 
         # Stop string: truncate the non-stream text at its first char.
         if full_text:
@@ -516,3 +517,54 @@ def test_stream_stop_deltas_consistent_and_device_budget():
         assert engine.last_decode_steps == 4
 
     _serve(tiny_t5_bundle, main)
+
+
+def test_v1_completions_compat():
+    """OpenAI-style /v1/completions rides the same serving path:
+    non-stream returns choices[0].text == /predict's text; SSE stream
+    deltas concatenate to the same; validation and model-kind 400s."""
+
+    async def body(client):
+        r_pred = await client.post("/predict", json={"text": "summarize: hi"})
+        want = (await r_pred.json())["prediction"]["text"]
+
+        r = await client.post("/v1/completions", json={"prompt": "summarize: hi"})
+        assert r.status == 200
+        out = await r.json()
+        assert out["object"] == "text_completion"
+        assert out["choices"][0]["text"] == want
+
+        r = await client.post(
+            "/v1/completions", json={"prompt": "summarize: hi", "stream": True}
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = await r.text()
+        events = [l[len("data: "):] for l in raw.splitlines()
+                  if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        texts = "".join(
+            json.loads(e)["choices"][0]["text"] for e in events[:-1]
+        )
+        assert texts == want
+
+        # prompt list of one is accepted; empty prompt is a 400.
+        r = await client.post("/v1/completions", json={"prompt": ["summarize: hi"]})
+        assert r.status == 200
+        r = await client.post("/v1/completions", json={"prompt": ""})
+        assert r.status == 400
+        # max_tokens caps like /predict.
+        r = await client.post(
+            "/v1/completions", json={"prompt": "summarize: hi", "max_tokens": 1}
+        )
+        assert r.status == 200
+
+    _run(tiny_t5_bundle, body)
+
+
+def test_v1_completions_rejects_non_generative():
+    async def body(client):
+        r = await client.post("/v1/completions", json={"prompt": "hi"})
+        assert r.status == 400
+
+    _run(tiny_bert_bundle, body)
