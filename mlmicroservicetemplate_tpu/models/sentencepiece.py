@@ -89,12 +89,26 @@ def _iter_fields(buf: bytes):
         yield field, wire, val
 
 
-def load_spiece_model(path: str) -> list[tuple[str, float, int]]:
-    """Parse a ``spiece.model`` → [(piece, score, type)] in id order."""
+# TrainerSpec.model_type enum values (sentencepiece.proto).
+MODEL_UNIGRAM = 1
+MODEL_BPE = 2
+
+
+def load_spiece_model_ex(path: str) -> tuple[list[tuple[str, float, int]], int]:
+    """Parse a ``spiece.model`` → ([(piece, score, type)] in id order,
+    trainer model_type).  model_type defaults to unigram when the file
+    carries no trainer_spec (e.g. fixtures written by
+    ``write_spiece_model`` without one)."""
     with open(path, "rb") as f:
         buf = f.read()
     pieces: list[tuple[str, float, int]] = []
+    model_type = MODEL_UNIGRAM
     for field, wire, val in _iter_fields(buf):
+        if field == 2 and wire == 2:  # ModelProto.trainer_spec
+            for sfield, swire, sval in _iter_fields(val):
+                if sfield == 3 and swire == 0:  # TrainerSpec.model_type
+                    model_type = int(sval)
+            continue
         if field != 1 or wire != 2:  # ModelProto.pieces
             continue
         piece, score, ptype = "", 0.0, TYPE_NORMAL
@@ -108,7 +122,12 @@ def load_spiece_model(path: str) -> list[tuple[str, float, int]]:
         pieces.append((piece, score, ptype))
     if not pieces:
         raise ValueError(f"{path}: no sentencepiece pieces found (wrong file?)")
-    return pieces
+    return pieces, model_type
+
+
+def load_spiece_model(path: str) -> list[tuple[str, float, int]]:
+    """Back-compat wrapper: pieces only."""
+    return load_spiece_model_ex(path)[0]
 
 
 def _varint(n: int) -> bytes:
@@ -123,8 +142,10 @@ def _varint(n: int) -> bytes:
             return bytes(out)
 
 
-def write_spiece_model(path: str, pieces: list[tuple[str, float, int]]) -> None:
-    """Serialize [(piece, score, type)] to a valid ``spiece.model``."""
+def write_spiece_model(path: str, pieces: list[tuple[str, float, int]],
+                       model_type: int | None = None) -> None:
+    """Serialize [(piece, score, type)] to a valid ``spiece.model``
+    (optionally with a trainer_spec carrying ``model_type``)."""
     body = bytearray()
     for piece, score, ptype in pieces:
         sub = bytearray()
@@ -133,6 +154,9 @@ def write_spiece_model(path: str, pieces: list[tuple[str, float, int]]) -> None:
         sub += _varint((2 << 3) | 5) + struct.pack("<f", score)
         sub += _varint((3 << 3) | 0) + _varint(ptype)
         body += _varint((1 << 3) | 2) + _varint(len(sub)) + bytes(sub)
+    if model_type is not None:
+        spec = _varint((3 << 3) | 0) + _varint(model_type)
+        body += _varint((2 << 3) | 2) + _varint(len(spec)) + spec
     with open(path, "wb") as f:
         f.write(bytes(body))
 
@@ -174,12 +198,18 @@ class SentencePieceTokenizer:
     """
 
     def __init__(self, pieces: list[tuple[str, float, int]], add_eos: bool = True,
-                 add_bos: bool = False):
+                 add_bos: bool = False, algorithm: str = "unigram"):
+        if algorithm not in ("unigram", "bpe"):
+            raise ValueError(f"algorithm must be unigram|bpe, got {algorithm!r}")
         self.pieces = pieces
         self.add_eos = add_eos
         # Llama-family convention: prompts start with <s> and do NOT end
         # in </s> (the exact inverse of T5's add_eos).
         self.add_bos = add_bos
+        # Segmentation algorithm, from the file's TrainerSpec: unigram
+        # (T5 family, Viterbi max-score) or BPE (Llama family, greedy
+        # best-scoring merges — scores encode merge order, -rank).
+        self.algorithm = algorithm
         self.vocab: dict[str, int] = {}
         self.byte_pieces: dict[int, int] = {}
         self.scores = np.full((len(pieces),), -1e9, np.float32)
@@ -244,20 +274,11 @@ class SentencePieceTokenizer:
                     best[i] = sc
                     back[i] = (j, (pid,))
             if best[i] <= NEG:
-                # OOV character s[i-1]: byte-fallback, else <unk>.
+                # OOV character s[i-1]: byte-fallback, else <unk>
+                # (shared with the BPE path — _ids_for_symbol).
                 j = i - 1
-                ch = s[j]
-                byte_ids = tuple(
-                    self.byte_pieces.get(b) for b in ch.encode("utf-8")
-                )
-                if byte_ids and None not in byte_ids:
-                    ids = byte_ids
-                else:
-                    # No (or only partial) byte-piece coverage for this
-                    # character: whole char becomes <unk>.
-                    ids = (self.unk_id,)
                 best[i] = best[j] + self._unk_score
-                back[i] = (j, ids)
+                back[i] = (j, self._ids_for_symbol(s[j]))
         out: list[int] = []
         i = n
         while i > 0:
@@ -267,8 +288,89 @@ class SentencePieceTokenizer:
         out.reverse()
         return out
 
+    def _ids_for_symbol(self, sym: str) -> tuple[int, ...]:
+        """Vocab id for a surviving symbol, byte-fallback, else <unk>."""
+        pid = self.vocab.get(sym)
+        if pid is not None:
+            return (pid,)
+        byte_ids = tuple(self.byte_pieces.get(b) for b in sym.encode("utf-8"))
+        if byte_ids and None not in byte_ids:
+            return byte_ids
+        return (self.unk_id,)
+
+    def _segment_bpe(self, s: str) -> list[int]:
+        """SentencePiece BPE: repeatedly merge the adjacent symbol pair
+        whose MERGED piece has the best score (scores are -merge-rank in
+        BPE models), leftmost on ties — bpe_model.cc's agenda order,
+        implemented the same way: a heap keyed (score desc, position
+        asc) over a doubly-linked symbol list, O(n log n) per word
+        instead of rescanning every pair after each merge.  Merges
+        never cross whitespace: each ▁-prefixed word segments
+        independently (split_by_whitespace, the library default)."""
+        import heapq
+
+        out: list[int] = []
+
+        def flush(word: list[str]) -> None:
+            n = len(word)
+            if n == 0:
+                return
+            syms = list(word)
+            nxt = list(range(1, n)) + [-1]
+            prv = [-1] + list(range(0, n - 1))
+            alive = [True] * n
+            heap: list[tuple[float, int, str, str]] = []
+
+            def consider(i: int) -> None:
+                j = nxt[i]
+                if j == -1:
+                    return
+                pid = self.vocab.get(syms[i] + syms[j])
+                if pid is not None:
+                    heapq.heappush(
+                        heap, (-float(self.scores[pid]), i, syms[i], syms[j])
+                    )
+
+            for i in range(n - 1):
+                consider(i)
+            while heap:
+                _, i, ls, rs = heapq.heappop(heap)
+                j = nxt[i] if alive[i] else -1
+                # Stale agenda entries (either side already merged away)
+                # are detected by symbol mismatch and skipped.
+                if j == -1 or not alive[i] or syms[i] != ls or syms[j] != rs:
+                    continue
+                syms[i] = ls + rs
+                alive[j] = False
+                nxt[i] = nxt[j]
+                if nxt[j] != -1:
+                    prv[nxt[j]] = i
+                consider(i)
+                if prv[i] != -1:
+                    consider(prv[i])
+            k = 0  # merges only ever remove the RIGHT symbol; 0 survives
+            while k != -1:
+                out.extend(self._ids_for_symbol(syms[k]))
+                k = nxt[k]
+
+        word: list[str] = []
+        for ch in s:
+            if ch == _META and word:
+                flush(word)
+                word = []
+            word.append(ch)
+        flush(word)
+        return out
+
     def encode(self, text: str, max_len: int) -> tuple[np.ndarray, np.ndarray]:
-        ids = self._segment(self._normalize(text))
+        seg = self._segment_bpe if self.algorithm == "bpe" else self._segment
+        s = self._normalize(text)
+        # Every output token covers >= 1 input char, so chars past
+        # max_len * max_piece_len cannot reach the truncated output —
+        # bound segmentation work on pathological (huge, space-free)
+        # request bodies.
+        s = s[: max_len * max(self.max_piece_len, 4)]
+        ids = seg(s)
         if self.add_bos and self.bos_id is not None:
             ids = [self.bos_id] + ids
         if self.add_eos:
@@ -317,9 +419,14 @@ class SentencePieceTokenizer:
 
 def load_sentencepiece(path: str, add_eos: bool = True,
                        add_bos: bool = False) -> SentencePieceTokenizer:
-    """Build from a binary ``spiece.model`` or a ``piece\\tscore`` tsv."""
+    """Build from a binary ``spiece.model`` or a ``piece\\tscore`` tsv.
+    The segmentation algorithm follows the file's TrainerSpec
+    (unigram = T5 family, BPE = Llama family)."""
     if path.endswith((".tsv", ".vocab")):
-        pieces = load_piece_tsv(path)
+        pieces, model_type = load_piece_tsv(path), MODEL_UNIGRAM
     else:
-        pieces = load_spiece_model(path)
-    return SentencePieceTokenizer(pieces, add_eos=add_eos, add_bos=add_bos)
+        pieces, model_type = load_spiece_model_ex(path)
+    return SentencePieceTokenizer(
+        pieces, add_eos=add_eos, add_bos=add_bos,
+        algorithm="bpe" if model_type == MODEL_BPE else "unigram",
+    )

@@ -182,3 +182,109 @@ def test_serving_path_text_fidelity(tmp_path):
     row = engine.run_batch([feats])[0]
     out = bundle.postprocess(row)
     assert out["prediction"]["text"] == text
+
+
+def _bpe_fixture():
+    """Hand-built SP-BPE piece table + the equivalent HF merge list.
+
+    Merges are PREFIX CHAINS (▁t, ▁th, ▁the, ...): each merge only
+    becomes available after its predecessor, so SentencePiece's
+    score-greedy inference and HF's rank-order replay provably take the
+    same path — the fixture where the two semantics coincide exactly.
+    """
+    words = ["hello", "world", "the", "quick"]
+    merges = []
+    for w in words:
+        prev = "▁"
+        for ch in w:
+            merges.append((prev, ch))
+            prev += ch
+    base = ["▁"] + sorted({c for w in words for c in w})
+    vocab_list = ["<unk>", "<s>", "</s>"] + base + [a + b for a, b in merges]
+    merged = [a + b for a, b in merges]
+    pieces = []
+    for tok in vocab_list:
+        if tok == "<unk>":
+            pieces.append((tok, 0.0, TYPE_UNKNOWN))
+        elif tok in ("<s>", "</s>"):
+            pieces.append((tok, 0.0, TYPE_CONTROL))
+        elif tok in base:
+            pieces.append((tok, 0.0, TYPE_NORMAL))
+        else:
+            # SP-BPE convention: merged piece score encodes merge order.
+            pieces.append((tok, float(-merged.index(tok)), TYPE_NORMAL))
+    return pieces, vocab_list, merges
+
+
+def test_spm_bpe_matches_hf_tokenizers():
+    """Our SP-BPE segmentation == HuggingFace `tokenizers`' BPE with the
+    Metaspace pre-tokenizer (the llama-family construction): same
+    pieces for the same text, ids aligned by construction."""
+    import pytest
+
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    pieces, vocab_list, merges = _bpe_fixture()
+    v = {t: i for i, t in enumerate(vocab_list)}
+    hf = Tokenizer(models.BPE(vocab=v, merges=list(merges), unk_token="<unk>"))
+    hf.pre_tokenizer = pre_tokenizers.Metaspace(
+        replacement="▁", prepend_scheme="always"
+    )
+    ours = SentencePieceTokenizer(pieces, add_eos=False, algorithm="bpe")
+
+    for text in ("hello world", "the quick", "hello the world quick",
+                 "held", "quell"):
+        want = hf.encode(text).ids
+        ids, mask = ours.encode(text, 64)
+        got = list(ids[: int(mask.sum())])
+        assert got == want, (text, got, want)
+
+
+def test_spm_bpe_model_file_roundtrip(tmp_path):
+    """A BPE-typed spiece.model (trainer_spec.model_type=2) loads with
+    the BPE segmenter automatically; unigram-typed files keep Viterbi."""
+    from mlmicroservicetemplate_tpu.models.sentencepiece import (
+        MODEL_BPE,
+        load_sentencepiece,
+    )
+
+    pieces, _, _ = _bpe_fixture()
+    mpath = str(tmp_path / "bpe.model")
+    write_spiece_model(mpath, pieces, model_type=MODEL_BPE)
+    tok = load_sentencepiece(mpath, add_eos=False, add_bos=True)
+    assert tok.algorithm == "bpe"
+    ids, mask = tok.encode("hello world", 32)
+    n = int(mask.sum())
+    assert int(ids[0]) == tok.bos_id
+    toks = [tok.pieces[i][0] for i in ids[1:n]]
+    assert toks == ["▁hello", "▁world"]
+    assert tok.decode(ids[:n]) == "hello world"
+
+    upath = str(tmp_path / "uni.model")
+    write_spiece_model(upath, pieces)  # no trainer_spec -> unigram
+    assert load_sentencepiece(upath).algorithm == "unigram"
+
+
+def test_spm_bpe_vocab_driven_merges():
+    """SP-BPE (bpe_model.cc) merges ANY adjacent pair whose CONCAT is a
+    vocab piece, score-greedy — not a replay of recorded merge pairs.
+    Here "the" forms via t+he even though training would have built it
+    as th+e; a merge-list replay (HF-style) would stall at [▁, t, he]."""
+    pieces = [
+        ("<unk>", 0.0, TYPE_UNKNOWN),
+        ("<s>", 0.0, TYPE_CONTROL),
+        ("</s>", 0.0, TYPE_CONTROL),
+        ("▁", 0.0, TYPE_NORMAL),
+        ("t", 0.0, TYPE_NORMAL),
+        ("h", 0.0, TYPE_NORMAL),
+        ("e", 0.0, TYPE_NORMAL),
+        ("he", -0.0, TYPE_NORMAL),
+        ("th", -10.0, TYPE_NORMAL),
+        ("the", -11.0, TYPE_NORMAL),
+        ("▁the", -12.0, TYPE_NORMAL),
+    ]
+    tok = SentencePieceTokenizer(pieces, add_eos=False, algorithm="bpe")
+    ids, mask = tok.encode("the", 16)
+    n = int(mask.sum())
+    assert [tok.pieces[i][0] for i in ids[:n]] == ["▁the"]
