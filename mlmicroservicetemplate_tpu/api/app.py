@@ -40,6 +40,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
 
     app.router.add_post("/predict", handle_predict)
     app.router.add_post("/v1/completions", handle_completions)
+    app.router.add_post("/v1/chat/completions", handle_chat_completions)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/status", handle_status)
@@ -406,6 +407,38 @@ async def _stream_predict(
 # /v1/completions — OpenAI-compatible alias over the same serving path
 
 
+async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
+    """Non-stream generation shared by /v1/completions and chat:
+    submit → trim to max_tokens → apply stop strings → finish_reason.
+    Maps failures to metered HTTP errors."""
+    loop = asyncio.get_running_loop()
+    try:
+        row = await app["batcher"].submit(feats)
+        full_len = int(np.count_nonzero(np.asarray(row) != bundle.cfg.pad_id))
+        if item.max_tokens is not None:
+            row = row[: item.max_tokens]
+        result = await loop.run_in_executor(None, bundle.postprocess, row)
+        text = result["prediction"]["text"]
+        stopped_by_string = False
+        if item.stop:
+            cut = _apply_stop(text, item.stop)
+            stopped_by_string = cut != text
+            text = cut
+        finish = "stop" if (
+            stopped_by_string
+            or item.max_tokens is None
+            or full_len <= item.max_tokens
+        ) else "length"
+        return text, finish
+    except QueueFullError:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise web.HTTPServiceUnavailable(reason="queue full, retry later")
+    except Exception:
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception("completion failed")
+        raise web.HTTPInternalServerError(reason="inference failed")
+
+
 async def handle_completions(request: web.Request) -> web.StreamResponse:
     """Completions-API compatibility for generative models: the field
     names OpenAI-style clients already speak (``prompt``/``max_tokens``/
@@ -456,30 +489,7 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
     if item.stream:
         return await _sse_completions(request, feats, item, t0)
 
-    try:
-        row = await app["batcher"].submit(feats)
-        full_len = int(np.count_nonzero(np.asarray(row) != bundle.cfg.pad_id))
-        if item.max_tokens is not None:
-            row = row[: item.max_tokens]
-        result = await loop.run_in_executor(None, bundle.postprocess, row)
-        text = result["prediction"]["text"]
-        stopped_by_string = False
-        if item.stop:
-            cut = _apply_stop(text, item.stop)
-            stopped_by_string = cut != text
-            text = cut
-        finish = "stop" if (
-            stopped_by_string
-            or item.max_tokens is None
-            or full_len <= item.max_tokens
-        ) else "length"
-    except QueueFullError:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise web.HTTPServiceUnavailable(reason="queue full, retry later")
-    except Exception:
-        metrics.REQUESTS.labels(bundle.name, "500").inc()
-        log.exception("completion failed")
-        raise web.HTTPInternalServerError(reason="inference failed")
+    text, finish = await _generate_once(app, bundle, feats, item)
     metrics.REQUESTS.labels(bundle.name, "200").inc()
     metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
     return web.json_response({
@@ -530,6 +540,175 @@ async def _sse_completions(
                 "choices": [{"index": 0, "text": "",
                              "finish_reason": ev["finish_reason"]}],
             }))
+            await resp.write(b"data: [DONE]\n\n")
+            metrics.REQUESTS.labels(bundle.name, "200").inc()
+            metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+    finally:
+        await stream_iter.aclose()
+        try:
+            await resp.write_eof()
+        except ConnectionError:
+            pass
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# /v1/chat/completions — chat alias over the same generative path
+
+
+def _render_chat(messages: list[dict]) -> str:
+    """Messages → one prompt string.
+
+    ``CHAT_TEMPLATE=plain`` (default) renders role-prefixed turns and a
+    trailing assistant cue — neutral and readable, the right default
+    for base (non-chat-tuned) checkpoints.  ``CHAT_TEMPLATE=llama2``
+    renders the Llama-2-chat [INST]/<<SYS>> format for checkpoints
+    trained on it.  Raises ValueError on malformed messages (the
+    handler maps it to 400).
+    """
+    if not isinstance(messages, list) or not messages:
+        raise ValueError('"messages" must be a non-empty list')
+    for m in messages:
+        if (
+            not isinstance(m, dict)
+            or m.get("role") not in ("system", "user", "assistant")
+            or not isinstance(m.get("content"), str)
+        ):
+            raise ValueError(
+                'each message needs role in {system,user,assistant} and '
+                'string "content"'
+            )
+    template = os.environ.get("CHAT_TEMPLATE", "plain").lower()
+    if template == "llama2":
+        system = "".join(
+            m["content"] for m in messages if m["role"] == "system"
+        )
+        turns = [m for m in messages if m["role"] != "system"]
+        out = []
+        pending: list[str] = []  # consecutive user messages accumulate
+        for m in turns:
+            if m["role"] == "user":
+                pending.append(m["content"])
+            else:  # assistant turn closes the pair
+                sys_block = (
+                    f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system and not out else ""
+                )
+                out.append(
+                    f"[INST] {sys_block}{chr(10).join(pending)} [/INST] "
+                    f"{m['content']}"
+                )
+                pending = []
+        if pending or not out:
+            # Open instruction only when there IS one; a transcript
+            # ending on an assistant turn continues as-is.
+            sys_block = (
+                f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system and not out else ""
+            )
+            out.append(f"[INST] {sys_block}{chr(10).join(pending)} [/INST]")
+        return " ".join(out)
+    if template != "plain":
+        # Server-side misconfiguration, not a client error — the
+        # handler maps LookupError to a 500.
+        raise LookupError(f"unknown CHAT_TEMPLATE {template!r} (plain|llama2)")
+    lines = [f"{m['role']}: {m['content']}" for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
+    """Chat-completions compatibility: render the message list to a
+    prompt (CHAT_TEMPLATE) and serve it through the SAME path as
+    /v1/completions, answering in the chat response shape."""
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    if bundle.kind != KIND_SEQ2SEQ:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=f"{bundle.name} is not a generative model")
+    t0 = time.monotonic()
+    try:
+        body = await request.json()
+        assert isinstance(body, dict)
+    except Exception:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason="invalid JSON body")
+    try:
+        prompt = _render_chat(body.get("messages"))
+        item = _parse_json_item({
+            "text": prompt,
+            "stream": bool(body.get("stream", False)),
+            "temperature": body.get("temperature", 0.0),
+            "top_p": body.get("top_p", 1.0),
+            "seed": body.get("seed"),
+            "max_tokens": body.get("max_tokens"),
+            "stop": body.get("stop"),
+        })
+    except LookupError as e:
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.error("%s", e)
+        raise web.HTTPInternalServerError(reason=str(e))
+    except ValueError as e:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=str(e))
+    except web.HTTPBadRequest:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise
+    loop = asyncio.get_running_loop()
+    try:
+        feats = await loop.run_in_executor(None, bundle.preprocess, item)
+    except (ValueError, OSError) as e:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=str(e) or "bad messages")
+
+    if item.stream:
+        return await _sse_chat(request, feats, item, t0)
+
+    text, finish = await _generate_once(app, bundle, feats, item)
+    metrics.REQUESTS.labels(bundle.name, "200").inc()
+    metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+    return web.json_response({
+        "object": "chat.completion",
+        "model": bundle.name,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish,
+        }],
+    })
+
+
+async def _sse_chat(
+    request: web.Request, feats: dict, item: RawItem, t0: float
+) -> web.StreamResponse:
+    app = request.app
+    bundle: ModelBundle = app["bundle"]
+    try:
+        stream_iter = app["batcher"].submit_stream(feats)
+    except QueueFullError:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise web.HTTPServiceUnavailable(reason="too many active streams")
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "text/event-stream",
+                 "Cache-Control": "no-cache", "X-Accel-Buffering": "no"},
+    )
+    resp.enable_chunked_encoding()
+    await resp.prepare(request)
+
+    def sse(delta: dict, finish) -> bytes:
+        return (f"data: " + json.dumps({
+            "object": "chat.completion.chunk",
+            "model": bundle.name,
+            "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+        }) + "\n\n").encode()
+
+    try:
+        await resp.write(sse({"role": "assistant"}, None))
+        async for ev in _delta_stream(bundle, stream_iter, item):
+            if "delta" in ev:
+                if ev["delta"]:
+                    await resp.write(sse({"content": ev["delta"]}, None))
+                continue
+            await resp.write(sse({}, ev["finish_reason"]))
             await resp.write(b"data: [DONE]\n\n")
             metrics.REQUESTS.labels(bundle.name, "200").inc()
             metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)

@@ -568,3 +568,97 @@ def test_v1_completions_rejects_non_generative():
         assert r.status == 400
 
     _run(tiny_bert_bundle, body)
+
+
+def test_v1_chat_completions():
+    """Chat endpoint: rendered messages ride the same path — content
+    equals /v1/completions on the rendered prompt; SSE chunk deltas
+    concatenate to it; malformed messages are 400s."""
+    import os
+
+    from mlmicroservicetemplate_tpu.api.app import _render_chat
+
+    messages = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "summarize: hello"},
+    ]
+    rendered = _render_chat(messages)
+    assert rendered.endswith("assistant:")
+
+    async def body(client):
+        r_ref = await client.post(
+            "/v1/completions", json={"prompt": rendered}
+        )
+        want = (await r_ref.json())["choices"][0]["text"]
+
+        r = await client.post("/v1/chat/completions", json={"messages": messages})
+        assert r.status == 200
+        out = await r.json()
+        assert out["object"] == "chat.completion"
+        msg = out["choices"][0]["message"]
+        assert msg["role"] == "assistant" and msg["content"] == want
+
+        r = await client.post(
+            "/v1/chat/completions", json={"messages": messages, "stream": True}
+        )
+        assert r.status == 200
+        events = [l[len("data: "):] for l in (await r.text()).splitlines()
+                  if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        assert parsed[0]["choices"][0]["delta"] == {"role": "assistant"}
+        content = "".join(
+            p["choices"][0]["delta"].get("content", "") for p in parsed
+        )
+        assert content == want
+        assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+        # Validation.
+        r = await client.post("/v1/chat/completions", json={"messages": []})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "wizard", "content": "x"}]},
+        )
+        assert r.status == 400
+
+    _run(tiny_t5_bundle, body)
+
+
+def test_chat_template_llama2(monkeypatch):
+    from mlmicroservicetemplate_tpu.api.app import _render_chat
+
+    monkeypatch.setenv("CHAT_TEMPLATE", "llama2")
+    out = _render_chat([
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+        {"role": "user", "content": "more"},
+    ])
+    assert out.startswith("[INST] <<SYS>>\nbe brief\n<</SYS>>\n\nhi [/INST] hello")
+    assert out.endswith("[INST] more [/INST]")
+    monkeypatch.setenv("CHAT_TEMPLATE", "nope")
+    import pytest
+
+    # Unknown template = SERVER misconfiguration (handler maps to 500).
+    with pytest.raises(LookupError, match="unknown CHAT_TEMPLATE"):
+        _render_chat([{"role": "user", "content": "x"}])
+
+
+def test_chat_template_llama2_edge_cases(monkeypatch):
+    """Consecutive user messages accumulate; a transcript ending on an
+    assistant turn does NOT append an empty open [INST]."""
+    from mlmicroservicetemplate_tpu.api.app import _render_chat
+
+    monkeypatch.setenv("CHAT_TEMPLATE", "llama2")
+    out = _render_chat([
+        {"role": "user", "content": "doc: abc"},
+        {"role": "user", "content": "summarize it"},
+    ])
+    assert "doc: abc\nsummarize it" in out
+    out = _render_chat([
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+    ])
+    assert not out.endswith("[/INST]") or out.endswith("hello")
+    assert "[INST]  [/INST]" not in out
