@@ -47,6 +47,12 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_post("/debug/trace", handle_trace)
 
+    # A misconfigured CHAT_TEMPLATE must fail at STARTUP, not as
+    # request-time 500s once the server already passed /readyz.
+    template = os.environ.get("CHAT_TEMPLATE", "plain").lower()
+    if template not in ("plain", "llama2"):
+        raise ValueError(f"unknown CHAT_TEMPLATE {template!r} (plain|llama2)")
+
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
     return app
@@ -439,19 +445,16 @@ async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
         raise web.HTTPInternalServerError(reason="inference failed")
 
 
-async def handle_completions(request: web.Request) -> web.StreamResponse:
-    """Completions-API compatibility for generative models: the field
-    names OpenAI-style clients already speak (``prompt``/``max_tokens``/
-    ``temperature``/``top_p``/``stop``/``stream``), served by the exact
-    same batcher/engine path as ``/predict``.  Streaming uses SSE
-    (``data: {...}`` lines ending with ``data: [DONE]``)."""
+async def _openai_prologue(request: web.Request, to_prompt):
+    """Shared /v1 prologue: seq2seq gate, JSON parse, prompt derivation
+    (``to_prompt(body) -> str`` — ValueError = client 400, LookupError =
+    server-config 500), field translation onto /predict's validator,
+    preprocess.  Returns (app, bundle, item, feats, t0)."""
     app = request.app
     bundle: ModelBundle = app["bundle"]
     if bundle.kind != KIND_SEQ2SEQ:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(
-            reason=f"{bundle.name} is not a generative model"
-        )
+        raise web.HTTPBadRequest(reason=f"{bundle.name} is not a generative model")
     t0 = time.monotonic()
     try:
         body = await request.json()
@@ -459,16 +462,9 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
     except Exception:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason="invalid JSON body")
-    prompt = body.get("prompt")
-    if isinstance(prompt, list):  # the API allows a singleton batch
-        prompt = prompt[0] if len(prompt) == 1 else None
-    if not isinstance(prompt, str) or not prompt:
-        metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(reason='"prompt" must be a non-empty string')
-    # Reuse /predict's JSON validation by translating the field names.
     try:
         item = _parse_json_item({
-            "text": prompt,
+            "text": to_prompt(body),
             "stream": bool(body.get("stream", False)),
             "temperature": body.get("temperature", 0.0),
             "top_p": body.get("top_p", 1.0),
@@ -476,6 +472,13 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
             "max_tokens": body.get("max_tokens"),
             "stop": body.get("stop"),
         })
+    except LookupError as e:
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.error("%s", e)
+        raise web.HTTPInternalServerError(reason=str(e))
+    except ValueError as e:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=str(e))
     except web.HTTPBadRequest:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise
@@ -484,27 +487,19 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
         feats = await loop.run_in_executor(None, bundle.preprocess, item)
     except (ValueError, OSError) as e:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(reason=str(e) or "bad prompt")
-
-    if item.stream:
-        return await _sse_completions(request, feats, item, t0)
-
-    text, finish = await _generate_once(app, bundle, feats, item)
-    metrics.REQUESTS.labels(bundle.name, "200").inc()
-    metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
-    return web.json_response({
-        "object": "text_completion",
-        "model": bundle.name,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish}],
-    })
+        raise web.HTTPBadRequest(reason=str(e) or "bad request")
+    return app, bundle, item, feats, t0
 
 
-async def _sse_completions(
-    request: web.Request, feats: dict, item: RawItem, t0: float
-) -> web.StreamResponse:
-    """SSE streaming in the completions shape, bridged off the same
-    ndjson machinery as /predict (tokens → cumulative decode → deltas
-    with stop holdback)."""
+def _sse_frame(payload: dict) -> bytes:
+    return (f"data: {json.dumps(payload)}\n\n").encode()
+
+
+async def _sse_stream(request, feats, item, t0, events, preamble=None):
+    """Shared SSE scaffolding for both /v1 streaming endpoints:
+    503 shedding, headers, the _delta_stream loop, [DONE], metrics and
+    cleanup.  ``events(ev) -> list[bytes]`` shapes each delta/final
+    event; ``preamble`` is written first (chat's role chunk)."""
     app = request.app
     bundle: ModelBundle = app["bundle"]
     try:
@@ -519,30 +514,16 @@ async def _sse_completions(
     )
     resp.enable_chunked_encoding()
     await resp.prepare(request)
-
-    def sse(payload: dict) -> bytes:
-        return (f"data: {json.dumps(payload)}\n\n").encode()
-
     try:
+        if preamble is not None:
+            await resp.write(preamble)
         async for ev in _delta_stream(bundle, stream_iter, item):
-            if "delta" in ev:
-                if ev["delta"]:
-                    await resp.write(sse({
-                        "object": "text_completion",
-                        "model": bundle.name,
-                        "choices": [{"index": 0, "text": ev["delta"],
-                                     "finish_reason": None}],
-                    }))
-                continue
-            await resp.write(sse({
-                "object": "text_completion",
-                "model": bundle.name,
-                "choices": [{"index": 0, "text": "",
-                             "finish_reason": ev["finish_reason"]}],
-            }))
-            await resp.write(b"data: [DONE]\n\n")
-            metrics.REQUESTS.labels(bundle.name, "200").inc()
-            metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+            for frame in events(ev):
+                await resp.write(frame)
+            if ev.get("done"):
+                await resp.write(b"data: [DONE]\n\n")
+                metrics.REQUESTS.labels(bundle.name, "200").inc()
+                metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
     finally:
         await stream_iter.aclose()
         try:
@@ -550,6 +531,51 @@ async def _sse_completions(
         except ConnectionError:
             pass
     return resp
+
+
+async def handle_completions(request: web.Request) -> web.StreamResponse:
+    """Completions-API compatibility for generative models: the field
+    names OpenAI-style clients already speak (``prompt``/``max_tokens``/
+    ``temperature``/``top_p``/``stop``/``stream``), served by the exact
+    same batcher/engine path as ``/predict``.  Streaming uses SSE
+    (``data: {...}`` lines ending with ``data: [DONE]``)."""
+
+    def to_prompt(body: dict) -> str:
+        prompt = body.get("prompt")
+        if isinstance(prompt, list):  # the API allows a singleton batch
+            prompt = prompt[0] if len(prompt) == 1 else None
+        if not isinstance(prompt, str) or not prompt:
+            raise ValueError('"prompt" must be a non-empty string')
+        return prompt
+
+    app, bundle, item, feats, t0 = await _openai_prologue(request, to_prompt)
+
+    if item.stream:
+        def events(ev):
+            if "delta" in ev:
+                if not ev["delta"]:
+                    return []
+                return [_sse_frame({
+                    "object": "text_completion", "model": bundle.name,
+                    "choices": [{"index": 0, "text": ev["delta"],
+                                 "finish_reason": None}],
+                })]
+            return [_sse_frame({
+                "object": "text_completion", "model": bundle.name,
+                "choices": [{"index": 0, "text": "",
+                             "finish_reason": ev["finish_reason"]}],
+            })]
+
+        return await _sse_stream(request, feats, item, t0, events)
+
+    text, finish = await _generate_once(app, bundle, feats, item)
+    metrics.REQUESTS.labels(bundle.name, "200").inc()
+    metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+    return web.json_response({
+        "object": "text_completion",
+        "model": bundle.name,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish}],
+    })
 
 
 # ---------------------------------------------------------------------------
@@ -580,35 +606,43 @@ def _render_chat(messages: list[dict]) -> str:
             )
     template = os.environ.get("CHAT_TEMPLATE", "plain").lower()
     if template == "llama2":
+        if not any(m["role"] == "user" for m in messages):
+            # The [INST] format has no rendering for a conversation with
+            # no instruction — an empty "[INST]  [/INST]" is garbage.
+            raise ValueError("llama2 template requires at least one user message")
         system = "".join(
             m["content"] for m in messages if m["role"] == "system"
         )
         turns = [m for m in messages if m["role"] != "system"]
         out = []
         pending: list[str] = []  # consecutive user messages accumulate
+        first_inst = True
+
+        def inst(user_text: str) -> str:
+            nonlocal first_inst
+            sys_block = (
+                f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system and first_inst else ""
+            )
+            first_inst = False
+            return f"[INST] {sys_block}{user_text} [/INST]"
+
         for m in turns:
             if m["role"] == "user":
                 pending.append(m["content"])
-            else:  # assistant turn closes the pair
-                sys_block = (
-                    f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system and not out else ""
-                )
-                out.append(
-                    f"[INST] {sys_block}{chr(10).join(pending)} [/INST] "
-                    f"{m['content']}"
-                )
+            elif pending:  # assistant turn closes the pair
+                out.append(f"{inst(chr(10).join(pending))} {m['content']}")
                 pending = []
-        if pending or not out:
-            # Open instruction only when there IS one; a transcript
-            # ending on an assistant turn continues as-is.
-            sys_block = (
-                f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system and not out else ""
-            )
-            out.append(f"[INST] {sys_block}{chr(10).join(pending)} [/INST]")
+            else:
+                # Assistant content with no preceding instruction
+                # (assistant-first transcript): continue it as-is.
+                out.append(m["content"])
+        if pending:
+            out.append(inst(chr(10).join(pending)))
         return " ".join(out)
     if template != "plain":
         # Server-side misconfiguration, not a client error — the
-        # handler maps LookupError to a 500.
+        # handler maps LookupError to a 500 (and build_app rejects it
+        # at startup).
         raise LookupError(f"unknown CHAT_TEMPLATE {template!r} (plain|llama2)")
     lines = [f"{m['role']}: {m['content']}" for m in messages]
     lines.append("assistant:")
@@ -618,49 +652,28 @@ def _render_chat(messages: list[dict]) -> str:
 async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     """Chat-completions compatibility: render the message list to a
     prompt (CHAT_TEMPLATE) and serve it through the SAME path as
-    /v1/completions, answering in the chat response shape."""
-    app = request.app
-    bundle: ModelBundle = app["bundle"]
-    if bundle.kind != KIND_SEQ2SEQ:
-        metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(reason=f"{bundle.name} is not a generative model")
-    t0 = time.monotonic()
-    try:
-        body = await request.json()
-        assert isinstance(body, dict)
-    except Exception:
-        metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(reason="invalid JSON body")
-    try:
-        prompt = _render_chat(body.get("messages"))
-        item = _parse_json_item({
-            "text": prompt,
-            "stream": bool(body.get("stream", False)),
-            "temperature": body.get("temperature", 0.0),
-            "top_p": body.get("top_p", 1.0),
-            "seed": body.get("seed"),
-            "max_tokens": body.get("max_tokens"),
-            "stop": body.get("stop"),
-        })
-    except LookupError as e:
-        metrics.REQUESTS.labels(bundle.name, "500").inc()
-        log.error("%s", e)
-        raise web.HTTPInternalServerError(reason=str(e))
-    except ValueError as e:
-        metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(reason=str(e))
-    except web.HTTPBadRequest:
-        metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise
-    loop = asyncio.get_running_loop()
-    try:
-        feats = await loop.run_in_executor(None, bundle.preprocess, item)
-    except (ValueError, OSError) as e:
-        metrics.REQUESTS.labels(bundle.name, "400").inc()
-        raise web.HTTPBadRequest(reason=str(e) or "bad messages")
+    /v1/completions, answering in the chat response shapes."""
+    app, bundle, item, feats, t0 = await _openai_prologue(
+        request, lambda body: _render_chat(body.get("messages"))
+    )
 
     if item.stream:
-        return await _sse_chat(request, feats, item, t0)
+        def chunk(delta: dict, finish) -> bytes:
+            return _sse_frame({
+                "object": "chat.completion.chunk", "model": bundle.name,
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}],
+            })
+
+        def events(ev):
+            if "delta" in ev:
+                return [chunk({"content": ev["delta"]}, None)] if ev["delta"] else []
+            return [chunk({}, ev["finish_reason"])]
+
+        return await _sse_stream(
+            request, feats, item, t0, events,
+            preamble=chunk({"role": "assistant"}, None),
+        )
 
     text, finish = await _generate_once(app, bundle, feats, item)
     metrics.REQUESTS.labels(bundle.name, "200").inc()
@@ -674,51 +687,6 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
             "finish_reason": finish,
         }],
     })
-
-
-async def _sse_chat(
-    request: web.Request, feats: dict, item: RawItem, t0: float
-) -> web.StreamResponse:
-    app = request.app
-    bundle: ModelBundle = app["bundle"]
-    try:
-        stream_iter = app["batcher"].submit_stream(feats)
-    except QueueFullError:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise web.HTTPServiceUnavailable(reason="too many active streams")
-    resp = web.StreamResponse(
-        status=200,
-        headers={"Content-Type": "text/event-stream",
-                 "Cache-Control": "no-cache", "X-Accel-Buffering": "no"},
-    )
-    resp.enable_chunked_encoding()
-    await resp.prepare(request)
-
-    def sse(delta: dict, finish) -> bytes:
-        return (f"data: " + json.dumps({
-            "object": "chat.completion.chunk",
-            "model": bundle.name,
-            "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
-        }) + "\n\n").encode()
-
-    try:
-        await resp.write(sse({"role": "assistant"}, None))
-        async for ev in _delta_stream(bundle, stream_iter, item):
-            if "delta" in ev:
-                if ev["delta"]:
-                    await resp.write(sse({"content": ev["delta"]}, None))
-                continue
-            await resp.write(sse({}, ev["finish_reason"]))
-            await resp.write(b"data: [DONE]\n\n")
-            metrics.REQUESTS.labels(bundle.name, "200").inc()
-            metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
-    finally:
-        await stream_iter.aclose()
-        try:
-            await resp.write_eof()
-        except ConnectionError:
-            pass
-    return resp
 
 
 # ---------------------------------------------------------------------------

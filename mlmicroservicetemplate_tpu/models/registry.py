@@ -395,7 +395,17 @@ def _build_bert_long(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         raise ValueError(
             f"SEQ_BUCKETS {bad} not divisible by sp mesh width {width}"
         )
-    ring = make_ring_attention(mesh)
+    raw_ring = make_ring_attention(mesh)
+    # Pallas hop kernel (VMEM-resident per-hop scores): single-block
+    # regime is per-DEVICE, so gate on the largest LOCAL block.
+    from ..ops.attention import use_pallas_attention
+
+    use_pallas_ring = use_pallas_attention(
+        max_seq=max(svc_cfg.seq_buckets) // width
+    )
+
+    def ring(q, k, v, key_mask):
+        return raw_ring(q, k, v, key_mask, use_pallas=use_pallas_ring)
 
     def forward(p, input_ids, attention_mask):
         return bert_mod.classify(

@@ -25,7 +25,71 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
-def _ring_attn_local(q, k, v, key_mask, *, axis_name: str, scale: float):
+def _hop_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref,
+                o_out, m_out, l_out, *, scale: float):
+    """One ring hop's online-softmax update for one (batch, head) cell:
+    the [S_loc, S_loc] score tile, mask, exp and the rescaled
+    accumulator updates all stay VMEM-resident — the unfused path
+    writes+reads the f32 score tensor through HBM on EVERY hop, n-1
+    times per layer."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [Sq, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [Sk, D]
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(mask_ref[0][0][None, :] != 0, s, jnp.float32(-1e9))
+    # m/l ride as [B, H, 1, S] (TPU block tiling wants the trailing two
+    # dims to equal the array's); index the singleton away here.
+    m_prev = m_ref[0, 0, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    corr = jnp.exp(m_prev - m_new)
+    l_out[0, 0, 0] = l_ref[0, 0, 0] * corr + p.sum(axis=-1)
+    pv = jax.lax.dot_general(
+        p, v_ref[0, 0].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_out[0, 0] = o_ref[0, 0] * corr[:, None] + pv
+    m_out[0, 0, 0] = m_new
+
+
+def _hop_pallas(qf, kc, vc, mc, o, m, l, *, scale: float, interpret: bool):
+    """Pallas dispatch of one hop: grid (B, H); accumulators in f32.
+
+    Shapes: qf/kc/vc [B, S, H, D] (q pre-transposed NOT needed — blocks
+    index [b, :, h, :] views via transpose outside), o [B,H,Sq,D],
+    m/l [B,H,Sq]."""
+    import functools
+
+    from jax.experimental import pallas as pl
+
+    b, s, h, d = qf.shape
+    qt = jnp.transpose(qf, (0, 2, 1, 3))
+    kt = jnp.transpose(kc, (0, 2, 1, 3))
+    vt = jnp.transpose(vc, (0, 2, 1, 3))
+    bhsd = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+    bh1s = pl.BlockSpec((1, 1, 1, s), lambda i, j: (i, j, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0))
+    o2, m2, l2 = pl.pallas_call(
+        functools.partial(_hop_kernel, scale=scale),
+        grid=(b, h),
+        in_specs=[bhsd, bhsd, bhsd, mask_spec, bhsd, bh1s, bh1s],
+        out_specs=[bhsd, bh1s, bh1s],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, mc.astype(jnp.int32)[:, None, :],
+      o, m[:, :, None, :], l[:, :, None, :])
+    return o2, m2[:, :, 0, :], l2[:, :, 0, :]
+
+
+def _ring_attn_local(q, k, v, key_mask, *, axis_name: str, scale: float,
+                     use_pallas: bool = False, interpret: bool = False):
     """Per-device body under shard_map.
 
     q, k, v: [B, S_loc, H, D] (local shard); key_mask: [B, S_loc].
@@ -37,15 +101,21 @@ def _ring_attn_local(q, k, v, key_mask, *, axis_name: str, scale: float):
 
     def step(i, carry):
         o, m, l, kc, vc, mc = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
-        s = jnp.where(mc[:, None, None, :] != 0, s, jnp.float32(-1e9))
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
-        )
+        if use_pallas:
+            o, m, l = _hop_pallas(
+                qf, kc, vc, mc, o, m, l, scale=scale, interpret=interpret
+            )
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)) * scale
+            s = jnp.where(mc[:, None, None, :] != 0, s, jnp.float32(-1e9))
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            corr = jnp.exp(m - m_new)
+            l = l * corr + p.sum(axis=-1)
+            o = o * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+            )
+            m = m_new
         # The final iteration's rotation would only be discarded — skip
         # it so each call pays n-1 K/V-block hops, not n.  (i is uniform
         # across the mesh, so every device takes the same branch and the
@@ -55,7 +125,7 @@ def _ring_attn_local(q, k, v, key_mask, *, axis_name: str, scale: float):
             return tuple(lax.ppermute(x, axis_name, perm) for x in ops)
 
         kc, vc, mc = lax.cond(i < n - 1, rotate, lambda ops: ops, (kc, vc, mc))
-        return (o, m_new, l, kc, vc, mc)
+        return (o, m, l, kc, vc, mc)
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     m0 = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
@@ -80,9 +150,13 @@ def make_ring_attention(mesh, axis: str = "sp"):
     """
     batch_axis = "replica" if "replica" in mesh.axis_names else None
 
-    def fn(q, k, v, key_mask):
+    def fn(q, k, v, key_mask, *, use_pallas: bool = False,
+           interpret: bool = False):
         scale = 1.0 / math.sqrt(q.shape[-1])
-        body = functools.partial(_ring_attn_local, axis_name=axis, scale=scale)
+        body = functools.partial(
+            _ring_attn_local, axis_name=axis, scale=scale,
+            use_pallas=use_pallas, interpret=interpret,
+        )
         seq_sharded = P(batch_axis, axis, None, None)
         return jax.shard_map(
             body,

@@ -662,3 +662,14 @@ def test_chat_template_llama2_edge_cases(monkeypatch):
     ])
     assert not out.endswith("[/INST]") or out.endswith("hello")
     assert "[INST]  [/INST]" not in out
+    # Assistant-first transcripts continue as-is (no empty [INST]).
+    out = _render_chat([
+        {"role": "assistant", "content": "hello there"},
+        {"role": "user", "content": "and?"},
+    ])
+    assert out.startswith("hello there") and "[INST]  [/INST]" not in out
+    # No user message at all has no llama2 rendering — client error.
+    import pytest
+
+    with pytest.raises(ValueError, match="user message"):
+        _render_chat([{"role": "system", "content": "sys only"}])
