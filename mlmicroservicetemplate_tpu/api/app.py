@@ -467,6 +467,7 @@ async def _openai_prologue(request: web.Request, to_prompt):
             "text": to_prompt(body),
             "stream": bool(body.get("stream", False)),
             "temperature": body.get("temperature", 0.0),
+            "top_k": body.get("top_k", 0),  # common extension field
             "top_p": body.get("top_p", 1.0),
             "seed": body.get("seed"),
             "max_tokens": body.get("max_tokens"),

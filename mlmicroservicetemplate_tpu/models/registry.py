@@ -176,11 +176,8 @@ def _attach_prompt_prefix(params, tokenizer, svc_cfg, compute_fn,
     prefix = getattr(svc_cfg, "prompt_prefix", None)
     if not prefix:
         return 0
-    if int(getattr(svc_cfg, "tp", 0) or 0) > 1:
-        raise ValueError(
-            "PROMPT_PREFIX and TP cannot combine yet (the TP param spec "
-            "does not cover the cached prefix KV subtree)"
-        )
+    # TP composes: TensorParallelSet replicates spec-unknown subtrees
+    # (the prefix KV) across the mesh — correct, just unsharded.
     import jax
 
     ids, mask = tokenizer.encode(prefix, max_positions)

@@ -188,11 +188,18 @@ class TensorParallelSet(ReplicaSet):
 
     def place_params(self, params):
         import jax
-        from jax.sharding import NamedSharding
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # Top-level subtrees the spec doesn't describe (e.g. a cached
+        # prompt-prefix KV attached after the spec was built) replicate
+        # — always correct, just not tp-sharded.
+        spec = dict(self.param_spec)
+        for key in params:
+            if key not in spec:
+                spec[key] = jax.tree.map(lambda _: P(), params[key])
         return jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
-            params, self.param_spec,
+            params, spec,
         )
 
     def pad_multiple(self) -> int:
