@@ -25,18 +25,29 @@ from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
+# Typed AppKeys (aiohttp's preferred registry): string keys work but
+# emit a NotAppKeyWarning per lookup — noisy enough to bury real
+# warnings in test runs and logs.
+K_CFG = web.AppKey("cfg", object)
+K_BUNDLE = web.AppKey("bundle", ModelBundle)
+K_ENGINE = web.AppKey("engine", object)
+K_BATCHER = web.AppKey("batcher", Batcher)
+K_READY = web.AppKey("ready", asyncio.Event)
+K_STARTED_AT = web.AppKey("started_at", float)
+K_STATE = web.AppKey("state", dict)
+
 
 def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Application:
     app = web.Application(client_max_size=32 * 1024 * 1024)
-    app["cfg"] = cfg
-    app["bundle"] = bundle
-    app["engine"] = engine
-    app["batcher"] = batcher
-    app["ready"] = asyncio.Event()
-    app["started_at"] = time.time()
+    app[K_CFG] = cfg
+    app[K_BUNDLE] = bundle
+    app[K_ENGINE] = engine
+    app[K_BATCHER] = batcher
+    app[K_READY] = asyncio.Event()
+    app[K_STARTED_AT] = time.time()
     # Mutable runtime state lives in one dict: aiohttp freezes the app
     # mapping once started, so post-startup writes must go through this.
-    app["state"] = {"ready_error": None, "warmup_s": None, "tracing": False}
+    app[K_STATE] = {"ready_error": None, "warmup_s": None, "tracing": False}
 
     app.router.add_post("/predict", handle_predict)
     app.router.add_post("/v1/completions", handle_completions)
@@ -59,7 +70,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
 
 
 async def _on_startup(app: web.Application) -> None:
-    cfg, engine, batcher = app["cfg"], app["engine"], app["batcher"]
+    cfg, engine, batcher = app[K_CFG], app[K_ENGINE], app[K_BATCHER]
     await batcher.start()
 
     async def warm_then_ready():
@@ -69,7 +80,7 @@ async def _on_startup(app: web.Application) -> None:
         try:
             if cfg.warmup:
                 loop = asyncio.get_running_loop()
-                app["state"]["warmup_s"] = await loop.run_in_executor(
+                app[K_STATE]["warmup_s"] = await loop.run_in_executor(
                     None, engine.warmup
                 )
                 # Continuous-batching executables (slot insert, batched
@@ -82,42 +93,47 @@ async def _on_startup(app: web.Application) -> None:
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            app["state"]["ready_error"] = f"{type(e).__name__}: {e}"
+            app[K_STATE]["ready_error"] = f"{type(e).__name__}: {e}"
             log.exception("warmup/canary failed; server will stay not-ready")
             return
-        app["ready"].set()
-        log.info("model %s ready", app["bundle"].name)
+        app[K_READY].set()
+        log.info("model %s ready", app[K_BUNDLE].name)
 
-    app["_ready_task"] = asyncio.get_running_loop().create_task(warm_then_ready())
+    # Tasks land in the K_STATE dict, not the app mapping: aiohttp has
+    # frozen the app by the time on_startup fires, and writes to a
+    # frozen app raise a DeprecationWarning (slated to become an error).
+    app[K_STATE]["_ready_task"] = asyncio.get_running_loop().create_task(
+        warm_then_ready()
+    )
 
     if cfg.server_url:
         from .registration import registration_loop
 
-        app["_register_task"] = asyncio.get_running_loop().create_task(
-            registration_loop(cfg, app["bundle"].name)
+        app[K_STATE]["_register_task"] = asyncio.get_running_loop().create_task(
+            registration_loop(cfg, app[K_BUNDLE].name)
         )
 
 
 async def _canary(app: web.Application) -> None:
-    bundle = app["bundle"]
+    bundle = app[K_BUNDLE]
     if bundle.kind == "image_classification":
         # uint8 like every real image path (the pipeline's wire dtype).
         feats = {"image": np.zeros((bundle.image_size, bundle.image_size, 3), np.uint8)}
     else:
         feats = {"input_ids": np.ones(8, np.int32), "length": np.int32(8)}
-    await app["batcher"].submit(feats)
+    await app[K_BATCHER].submit(feats)
 
 
 async def _on_cleanup(app: web.Application) -> None:
     for key in ("_ready_task", "_register_task"):
-        task = app.get(key)
+        task = app[K_STATE].get(key)
         if task is not None:
             task.cancel()
             try:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
-    await app["batcher"].stop()
+    await app[K_BATCHER].stop()
 
 
 # ---------------------------------------------------------------------------
@@ -206,7 +222,7 @@ def _parse_json_item(body: dict) -> RawItem:
 
 async def handle_predict(request: web.Request) -> web.StreamResponse:
     app = request.app
-    bundle: ModelBundle = app["bundle"]
+    bundle: ModelBundle = app[K_BUNDLE]
     t0 = time.monotonic()
     try:
         item = await _parse_request(request)
@@ -229,7 +245,7 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
         return await _stream_predict(request, feats, t0, item)
 
     try:
-        row = await app["batcher"].submit(feats)
+        row = await app[K_BATCHER].submit(feats)
         if bundle.kind == KIND_SEQ2SEQ and item.max_tokens is not None:
             row = row[: item.max_tokens]
         # Postprocess sits inside the same try: EVERY terminal status on
@@ -355,9 +371,9 @@ async def _stream_predict(
 ) -> web.StreamResponse:
     """Chunked seq2seq streaming: ndjson lines of decoded-token deltas."""
     app = request.app
-    bundle: ModelBundle = app["bundle"]
+    bundle: ModelBundle = app[K_BUNDLE]
     try:
-        stream_iter = app["batcher"].submit_stream(feats)
+        stream_iter = app[K_BATCHER].submit_stream(feats)
     except QueueFullError:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
         raise web.HTTPServiceUnavailable(reason="too many active streams, retry later")
@@ -419,7 +435,7 @@ async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
     Maps failures to metered HTTP errors."""
     loop = asyncio.get_running_loop()
     try:
-        row = await app["batcher"].submit(feats)
+        row = await app[K_BATCHER].submit(feats)
         full_len = int(np.count_nonzero(np.asarray(row) != bundle.cfg.pad_id))
         if item.max_tokens is not None:
             row = row[: item.max_tokens]
@@ -451,7 +467,7 @@ async def _openai_prologue(request: web.Request, to_prompt):
     server-config 500), field translation onto /predict's validator,
     preprocess.  Returns (app, bundle, item, feats, t0)."""
     app = request.app
-    bundle: ModelBundle = app["bundle"]
+    bundle: ModelBundle = app[K_BUNDLE]
     if bundle.kind != KIND_SEQ2SEQ:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=f"{bundle.name} is not a generative model")
@@ -502,9 +518,9 @@ async def _sse_stream(request, feats, item, t0, events, preamble=None):
     cleanup.  ``events(ev) -> list[bytes]`` shapes each delta/final
     event; ``preamble`` is written first (chat's role chunk)."""
     app = request.app
-    bundle: ModelBundle = app["bundle"]
+    bundle: ModelBundle = app[K_BUNDLE]
     try:
-        stream_iter = app["batcher"].submit_stream(feats)
+        stream_iter = app[K_BATCHER].submit_stream(feats)
     except QueueFullError:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
         raise web.HTTPServiceUnavailable(reason="too many active streams")
@@ -699,10 +715,10 @@ async def handle_healthz(request: web.Request) -> web.Response:
 
 
 async def handle_readyz(request: web.Request) -> web.Response:
-    if request.app["ready"].is_set():
+    if request.app[K_READY].is_set():
         return web.json_response({"ready": True})
     body = {"ready": False}
-    err = request.app["state"]["ready_error"]
+    err = request.app[K_STATE]["ready_error"]
     if err:
         body["error"] = err
     return web.json_response(body, status=503)
@@ -711,30 +727,30 @@ async def handle_readyz(request: web.Request) -> web.Response:
 async def handle_status(request: web.Request) -> web.Response:
     """Template-parity introspection endpoint (SURVEY.md §3.5)."""
     app = request.app
-    bundle: ModelBundle = app["bundle"]
+    bundle: ModelBundle = app[K_BUNDLE]
     import jax
 
-    engine = app["engine"]
+    engine = app[K_ENGINE]
     body = {
         "model": bundle.name,
         "kind": bundle.kind,
-        "ready": app["ready"].is_set(),
+        "ready": app[K_READY].is_set(),
         "device": jax.default_backend(),
         "n_devices": engine.replicas.n_devices,
-        "max_batch": app["cfg"].max_batch,
-        "uptime_s": round(time.time() - app["started_at"], 1),
+        "max_batch": app[K_CFG].max_batch,
+        "uptime_s": round(time.time() - app[K_STARTED_AT], 1),
         # Compiled-executable inventory + startup cost: the operator-
         # facing answer to "what shapes are warm and what did warming
         # them cost" (each bucket is one XLA executable).
         "batch_buckets": list(engine.batch_buckets),
         "seq_buckets": list(engine.seq_buckets),
         "warmup_s": (
-            round(app["state"]["warmup_s"], 3)
-            if app["state"]["warmup_s"] is not None
+            round(app[K_STATE]["warmup_s"], 3)
+            if app[K_STATE]["warmup_s"] is not None
             else None
         ),
     }
-    err = app["state"]["ready_error"]
+    err = app[K_STATE]["ready_error"]
     if err:
         body["ready_error"] = err
     return web.json_response(body)
@@ -766,9 +782,9 @@ async def handle_trace(request: web.Request) -> web.Response:
     # client-controlled — this endpoint must not become an
     # arbitrary-path file-write primitive.
     trace_dir = os.environ.get("JAX_TRACE_DIR", "/tmp/jax-trace")
-    if request.app["state"]["tracing"]:
+    if request.app[K_STATE]["tracing"]:
         raise web.HTTPConflict(reason="a trace is already running")
-    request.app["state"]["tracing"] = True
+    request.app[K_STATE]["tracing"] = True
     import jax
 
     try:
@@ -779,7 +795,7 @@ async def handle_trace(request: web.Request) -> web.Response:
             jax.profiler.stop_trace()
         except Exception as e:
             log.warning("stop_trace failed: %s", e)
-        request.app["state"]["tracing"] = False
+        request.app[K_STATE]["tracing"] = False
     return web.json_response(
         {"trace_dir": trace_dir, "seconds": seconds,
          "hint": "open in perfetto or tensorboard --logdir"}
