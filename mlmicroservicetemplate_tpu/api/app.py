@@ -52,6 +52,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_post("/predict", handle_predict)
     app.router.add_post("/v1/completions", handle_completions)
     app.router.add_post("/v1/chat/completions", handle_chat_completions)
+    app.router.add_get("/v1/models", handle_models)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/status", handle_status)
@@ -60,9 +61,26 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
 
     # A misconfigured CHAT_TEMPLATE must fail at STARTUP, not as
     # request-time 500s once the server already passed /readyz.
+    from .chat import TEMPLATES, validate_chat_template
+
     template = os.environ.get("CHAT_TEMPLATE", "plain").lower()
-    if template not in ("plain", "llama2"):
-        raise ValueError(f"unknown CHAT_TEMPLATE {template!r} (plain|llama2)")
+    if template not in TEMPLATES:
+        raise ValueError(
+            f"unknown CHAT_TEMPLATE {template!r} ({'|'.join(TEMPLATES)})"
+        )
+    # Template↔model pairing check: probe the serving tokenizer for the
+    # template's special markers; a vocabulary that shatters them was
+    # not tuned on this format — serving would silently mis-prompt the
+    # checkpoint (e.g. llama2 [INST] against a zephyr-tuned TinyLlama).
+    tmpl_warnings = (
+        validate_chat_template(template, bundle.tokenizer)
+        if bundle.kind == KIND_SEQ2SEQ
+        else []
+    )
+    for w in tmpl_warnings:
+        log.warning("%s", w)
+    app[K_STATE]["chat_template"] = template
+    app[K_STATE]["chat_template_warnings"] = tmpl_warnings
 
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
@@ -429,10 +447,25 @@ async def _stream_predict(
 # /v1/completions — OpenAI-compatible alias over the same serving path
 
 
+def _usage(feats: dict, completion_tokens: int) -> dict:
+    """OpenAI ``usage`` object — the one response field nearly every
+    client reads.  ``completion_tokens`` counts the tokens of the
+    RETURNED text: capped by max_tokens and trimmed to a stop-string
+    truncation — identical semantics on the stream and non-stream
+    paths (the stream path trims in ``_delta_stream``)."""
+    prompt = int(feats.get("length", 0))
+    return {
+        "prompt_tokens": prompt,
+        "completion_tokens": int(completion_tokens),
+        "total_tokens": prompt + int(completion_tokens),
+    }
+
+
 async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
     """Non-stream generation shared by /v1/completions and chat:
     submit → trim to max_tokens → apply stop strings → finish_reason.
-    Maps failures to metered HTTP errors."""
+    Returns (text, finish_reason, completion_token_count); maps
+    failures to metered HTTP errors."""
     loop = asyncio.get_running_loop()
     try:
         row = await app[K_BATCHER].submit(feats)
@@ -441,17 +474,29 @@ async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
             row = row[: item.max_tokens]
         result = await loop.run_in_executor(None, bundle.postprocess, row)
         text = result["prediction"]["text"]
+        n_tok = min(full_len, item.max_tokens or full_len)
         stopped_by_string = False
         if item.stop:
             cut = _apply_stop(text, item.stop)
             stopped_by_string = cut != text
+            if stopped_by_string:
+                # Token count must not run past the truncation (same
+                # rule as _delta_stream): smallest count whose decode
+                # covers the final text.
+                row_list = [int(t) for t in np.asarray(row).tolist()]
+                for n in range(n_tok + 1):
+                    if len(bundle.tokenizer.decode(
+                        np.array(row_list[:n], np.int32)
+                    )) >= len(cut):
+                        n_tok = n
+                        break
             text = cut
         finish = "stop" if (
             stopped_by_string
             or item.max_tokens is None
             or full_len <= item.max_tokens
         ) else "length"
-        return text, finish
+        return text, finish, n_tok
     except QueueFullError:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
         raise web.HTTPServiceUnavailable(reason="queue full, retry later")
@@ -478,6 +523,26 @@ async def _openai_prologue(request: web.Request, to_prompt):
     except Exception:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason="invalid JSON body")
+    # Unsupported OpenAI fields get an EXPLICIT 400, not a silent drop —
+    # a client that asked for n=4 or logprobs and got neither would
+    # otherwise misread the response as complete.
+    unsupported = None
+    if body.get("n") not in (None, 1):
+        unsupported = '"n" > 1 is not supported (one choice per request)'
+    elif body.get("best_of") not in (None, 1):
+        unsupported = '"best_of" > 1 is not supported'
+    elif (
+        # logprobs=0 is a real legacy-completions request ("chosen
+        # token's logprob, 0 alternatives") — only None/False mean
+        # "not asked for"; top_logprobs=0 genuinely means none.
+        # Identity checks: `in (None, False)` would eat 0 (0 == False).
+        (body.get("logprobs") is not None and body.get("logprobs") is not False)
+        or body.get("top_logprobs") not in (None, 0)
+    ):
+        unsupported = '"logprobs" is not supported'
+    if unsupported:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise web.HTTPBadRequest(reason=unsupported)
     try:
         item = _parse_json_item({
             "text": to_prompt(body),
@@ -581,17 +646,19 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
                 "object": "text_completion", "model": bundle.name,
                 "choices": [{"index": 0, "text": "",
                              "finish_reason": ev["finish_reason"]}],
+                "usage": _usage(feats, ev["tokens"]),
             })]
 
         return await _sse_stream(request, feats, item, t0, events)
 
-    text, finish = await _generate_once(app, bundle, feats, item)
+    text, finish, n_tok = await _generate_once(app, bundle, feats, item)
     metrics.REQUESTS.labels(bundle.name, "200").inc()
     metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
     return web.json_response({
         "object": "text_completion",
         "model": bundle.name,
         "choices": [{"index": 0, "text": text, "finish_reason": finish}],
+        "usage": _usage(feats, n_tok),
     })
 
 
@@ -599,100 +666,53 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
 # /v1/chat/completions — chat alias over the same generative path
 
 
-def _render_chat(messages: list[dict]) -> str:
-    """Messages → one prompt string.
+def _render_chat(messages: list[dict], template: str | None = None) -> str:
+    """Messages → one prompt string via the CHAT_TEMPLATE renderer
+    (``api/chat.py``: plain|llama2|chatml|zephyr|llama3).  The handler
+    passes the STARTUP-VALIDATED template from app state — re-reading
+    the env per request would bypass build_app's validation (and the
+    tokenizer probe) if the env mutated after startup.  The env
+    fallback serves direct callers/tests only.  ValueError on malformed
+    messages (handler maps to 400); LookupError on an unknown template
+    (server misconfiguration → 500)."""
+    from .chat import render_chat
 
-    ``CHAT_TEMPLATE=plain`` (default) renders role-prefixed turns and a
-    trailing assistant cue — neutral and readable, the right default
-    for base (non-chat-tuned) checkpoints.  ``CHAT_TEMPLATE=llama2``
-    renders the Llama-2-chat [INST]/<<SYS>> format for checkpoints
-    trained on it.  Raises ValueError on malformed messages (the
-    handler maps it to 400).
-    """
-    if not isinstance(messages, list) or not messages:
-        raise ValueError('"messages" must be a non-empty list')
-    for m in messages:
-        if (
-            not isinstance(m, dict)
-            or m.get("role") not in ("system", "user", "assistant")
-            or not isinstance(m.get("content"), str)
-        ):
-            raise ValueError(
-                'each message needs role in {system,user,assistant} and '
-                'string "content"'
-            )
-    template = os.environ.get("CHAT_TEMPLATE", "plain").lower()
-    if template == "llama2":
-        if not any(m["role"] == "user" for m in messages):
-            # The [INST] format has no rendering for a conversation with
-            # no instruction — an empty "[INST]  [/INST]" is garbage.
-            raise ValueError("llama2 template requires at least one user message")
-        system = "".join(
-            m["content"] for m in messages if m["role"] == "system"
-        )
-        turns = [m for m in messages if m["role"] != "system"]
-        out = []
-        pending: list[str] = []  # consecutive user messages accumulate
-        first_inst = True
-
-        def inst(user_text: str) -> str:
-            nonlocal first_inst
-            sys_block = (
-                f"<<SYS>>\n{system}\n<</SYS>>\n\n" if system and first_inst else ""
-            )
-            first_inst = False
-            return f"[INST] {sys_block}{user_text} [/INST]"
-
-        for m in turns:
-            if m["role"] == "user":
-                pending.append(m["content"])
-            elif pending:  # assistant turn closes the pair
-                out.append(f"{inst(chr(10).join(pending))} {m['content']}")
-                pending = []
-            else:
-                # Assistant content with no preceding instruction
-                # (assistant-first transcript): continue it as-is.
-                out.append(m["content"])
-        if pending:
-            out.append(inst(chr(10).join(pending)))
-        return " ".join(out)
-    if template != "plain":
-        # Server-side misconfiguration, not a client error — the
-        # handler maps LookupError to a 500 (and build_app rejects it
-        # at startup).
-        raise LookupError(f"unknown CHAT_TEMPLATE {template!r} (plain|llama2)")
-    lines = [f"{m['role']}: {m['content']}" for m in messages]
-    lines.append("assistant:")
-    return "\n".join(lines)
+    if template is None:
+        template = os.environ.get("CHAT_TEMPLATE", "plain").lower()
+    return render_chat(messages, template)
 
 
 async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     """Chat-completions compatibility: render the message list to a
     prompt (CHAT_TEMPLATE) and serve it through the SAME path as
     /v1/completions, answering in the chat response shapes."""
+    tmpl = request.app[K_STATE].get("chat_template")
     app, bundle, item, feats, t0 = await _openai_prologue(
-        request, lambda body: _render_chat(body.get("messages"))
+        request, lambda body: _render_chat(body.get("messages"), tmpl)
     )
 
     if item.stream:
-        def chunk(delta: dict, finish) -> bytes:
-            return _sse_frame({
+        def chunk(delta: dict, finish, usage: dict | None = None) -> bytes:
+            payload = {
                 "object": "chat.completion.chunk", "model": bundle.name,
                 "choices": [{"index": 0, "delta": delta,
                              "finish_reason": finish}],
-            })
+            }
+            if usage is not None:
+                payload["usage"] = usage
+            return _sse_frame(payload)
 
         def events(ev):
             if "delta" in ev:
                 return [chunk({"content": ev["delta"]}, None)] if ev["delta"] else []
-            return [chunk({}, ev["finish_reason"])]
+            return [chunk({}, ev["finish_reason"], _usage(feats, ev["tokens"]))]
 
         return await _sse_stream(
             request, feats, item, t0, events,
             preamble=chunk({"role": "assistant"}, None),
         )
 
-    text, finish = await _generate_once(app, bundle, feats, item)
+    text, finish, n_tok = await _generate_once(app, bundle, feats, item)
     metrics.REQUESTS.labels(bundle.name, "200").inc()
     metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
     return web.json_response({
@@ -702,6 +722,23 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
             "index": 0,
             "message": {"role": "assistant", "content": text},
             "finish_reason": finish,
+        }],
+        "usage": _usage(feats, n_tok),
+    })
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    """OpenAI ``/v1/models`` listing: one entry, the served model —
+    clients use it for discovery/selection before their first call."""
+    app = request.app
+    bundle: ModelBundle = app[K_BUNDLE]
+    return web.json_response({
+        "object": "list",
+        "data": [{
+            "id": bundle.name,
+            "object": "model",
+            "created": int(app[K_STARTED_AT]),
+            "owned_by": "mlmicroservicetemplate-tpu",
         }],
     })
 
@@ -753,6 +790,11 @@ async def handle_status(request: web.Request) -> web.Response:
     err = app[K_STATE]["ready_error"]
     if err:
         body["ready_error"] = err
+    if bundle.kind == KIND_SEQ2SEQ:
+        body["chat_template"] = app[K_STATE].get("chat_template", "plain")
+        warns = app[K_STATE].get("chat_template_warnings") or []
+        if warns:
+            body["chat_template_warnings"] = warns
     return web.json_response(body)
 
 

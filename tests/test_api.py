@@ -673,3 +673,194 @@ def test_chat_template_llama2_edge_cases(monkeypatch):
 
     with pytest.raises(ValueError, match="user message"):
         _render_chat([{"role": "system", "content": "sys only"}])
+
+
+def test_chat_templates_chatml_zephyr_llama3(monkeypatch):
+    """Golden rendered transcripts for the chat-tuned template family
+    (VERDICT r3 item 5): each format's markers, ordering, and trailing
+    assistant cue are exact."""
+    from mlmicroservicetemplate_tpu.api.app import _render_chat
+
+    messages = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+        {"role": "user", "content": "more"},
+    ]
+
+    monkeypatch.setenv("CHAT_TEMPLATE", "chatml")
+    assert _render_chat(messages) == (
+        "<|im_start|>system\nbe brief<|im_end|>\n"
+        "<|im_start|>user\nhi<|im_end|>\n"
+        "<|im_start|>assistant\nhello<|im_end|>\n"
+        "<|im_start|>user\nmore<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+
+    monkeypatch.setenv("CHAT_TEMPLATE", "zephyr")
+    assert _render_chat(messages) == (
+        "<|system|>\nbe brief</s>\n"
+        "<|user|>\nhi</s>\n"
+        "<|assistant|>\nhello</s>\n"
+        "<|user|>\nmore</s>\n"
+        "<|assistant|>\n"
+    )
+
+    monkeypatch.setenv("CHAT_TEMPLATE", "llama3")
+    out = _render_chat(messages)
+    assert out == (
+        "<|start_header_id|>system<|end_header_id|>\n\nbe brief<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\nhi<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\nhello<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\nmore<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    )
+    # BOS belongs to the tokenizer, never the rendered string (it
+    # would be doubled by SentencePiece add_bos).
+    assert "<|begin_of_text|>" not in out
+
+
+def test_chat_template_validation_probe():
+    """validate_chat_template flags markers the serving vocabulary
+    shatters (wrong-template detector) and passes ones it knows."""
+    from helpers import tiny_t5_bundle
+    from mlmicroservicetemplate_tpu.api.chat import validate_chat_template
+
+    tok = tiny_t5_bundle().tokenizer
+    # plain has no markers: never warns, on any tokenizer.
+    assert validate_chat_template("plain", tok) == []
+    assert validate_chat_template("plain", None) == []
+    # A byte/wordpiece-style tiny tokenizer shatters "<|im_start|>".
+    warns = validate_chat_template("chatml", tok)
+    assert warns and "<|im_start|>" in warns[0]
+
+
+def test_build_app_rejects_unknown_template_and_warns_mismatch(monkeypatch):
+    """Startup validation: unknown CHAT_TEMPLATE raises; a known
+    template whose markers the tokenizer shatters surfaces warnings in
+    app state (and /status)."""
+    from mlmicroservicetemplate_tpu.api.app import K_STATE
+
+    cfg = _cfg()
+    bundle = tiny_t5_bundle()
+    engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    batcher = Batcher(engine, cfg)
+    monkeypatch.setenv("CHAT_TEMPLATE", "nope")
+    with pytest.raises(ValueError, match="unknown CHAT_TEMPLATE"):
+        build_app(cfg, bundle, engine, batcher)
+    monkeypatch.setenv("CHAT_TEMPLATE", "zephyr")
+    app = build_app(cfg, bundle, engine, batcher)
+    assert app[K_STATE]["chat_template"] == "zephyr"
+    assert app[K_STATE]["chat_template_warnings"]  # tiny tok shatters <|user|>
+
+
+def test_v1_models_usage_and_explicit_400s():
+    """/v1/models lists the served model; usage appears on non-stream
+    JSON and final SSE chunks of both /v1 endpoints; unsupported
+    OpenAI fields (n>1, logprobs, best_of>1) 400 explicitly."""
+
+    async def body(client):
+        r = await client.get("/v1/models")
+        assert r.status == 200
+        out = await r.json()
+        assert out["object"] == "list"
+        assert out["data"][0]["id"] == "t5-small"
+        assert out["data"][0]["object"] == "model"
+
+        # Non-stream completions: usage consistent with the prompt.
+        r = await client.post("/v1/completions", json={"prompt": "summarize: hi"})
+        u = (await r.json())["usage"]
+        assert u["prompt_tokens"] > 0 and u["completion_tokens"] >= 1
+        assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+        # Streaming completions: final data chunk (pre-[DONE]) carries it.
+        r = await client.post(
+            "/v1/completions", json={"prompt": "summarize: hi", "stream": True}
+        )
+        events = [l[len("data: "):] for l in (await r.text()).splitlines()
+                  if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        final = json.loads(events[-2])
+        assert final["usage"]["total_tokens"] == (
+            final["usage"]["prompt_tokens"] + final["usage"]["completion_tokens"]
+        )
+        assert final["usage"]["completion_tokens"] >= 1
+
+        # Chat: both shapes too.
+        messages = [{"role": "user", "content": "summarize: hi"}]
+        r = await client.post("/v1/chat/completions", json={"messages": messages})
+        u = (await r.json())["usage"]
+        assert u["completion_tokens"] >= 1 and u["prompt_tokens"] > 0
+        r = await client.post(
+            "/v1/chat/completions", json={"messages": messages, "stream": True}
+        )
+        events = [l[len("data: "):] for l in (await r.text()).splitlines()
+                  if l.startswith("data: ")]
+        final = json.loads(events[-2])
+        assert final["usage"]["completion_tokens"] >= 1
+
+        # max_tokens caps completion_tokens exactly.
+        r = await client.post(
+            "/v1/completions", json={"prompt": "summarize: hi", "max_tokens": 1}
+        )
+        assert (await r.json())["usage"]["completion_tokens"] <= 1
+
+        # Unsupported OpenAI fields: explicit 400s, not silent drops.
+        for bad in (
+            {"prompt": "x", "n": 2},
+            {"prompt": "x", "best_of": 3},
+            {"prompt": "x", "logprobs": 5},
+            {"prompt": "x", "top_logprobs": 1},
+        ):
+            r = await client.post("/v1/completions", json=bad)
+            assert r.status == 400, bad
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}], "n": 2},
+        )
+        assert r.status == 400
+        # n=1 / null are fine (clients send them explicitly).
+        r = await client.post("/v1/completions", json={"prompt": "summarize: hi", "n": 1})
+        assert r.status == 200
+
+    _run(tiny_t5_bundle, body)
+
+
+def test_usage_stop_truncation_consistent_and_logprobs_zero():
+    """Stop-string truncation trims completion_tokens identically on
+    stream and non-stream paths; logprobs=0 (a real legacy request we
+    don't serve) is an explicit 400, not a silent drop."""
+
+    async def body(client):
+        r = await client.post("/predict", json={"text": "summarize: hello"})
+        full_text = (await r.json())["prediction"]["text"]
+        if len(full_text) >= 2:
+            stop = full_text[1]
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "summarize: hello", "stop": stop},
+            )
+            out = await r.json()
+            ns_usage = out["usage"]
+            assert stop not in out["choices"][0]["text"]
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "summarize: hello", "stop": stop, "stream": True},
+            )
+            events = [l[len("data: "):] for l in (await r.text()).splitlines()
+                      if l.startswith("data: ")]
+            s_usage = json.loads(events[-2])["usage"]
+            assert s_usage["completion_tokens"] == ns_usage["completion_tokens"]
+            assert s_usage["prompt_tokens"] == ns_usage["prompt_tokens"]
+
+        r = await client.post(
+            "/v1/completions", json={"prompt": "x", "logprobs": 0}
+        )
+        assert r.status == 400
+        # top_logprobs=0 means "none" — allowed.
+        r = await client.post(
+            "/v1/completions", json={"prompt": "summarize: hi", "top_logprobs": 0}
+        )
+        assert r.status == 200
+
+    _run(tiny_t5_bundle, body)
