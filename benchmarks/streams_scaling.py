@@ -112,6 +112,60 @@ def _continuous(eng, cfg, feats, n: int) -> dict:
     return stats
 
 
+def _admission_stall(eng, cfg, feats, overlap: bool) -> dict:
+    """Inter-chunk gaps of LIVE streams while a late wave joins — the
+    number that exposes admission head-of-line blocking (round-3
+    verdict missing #2).  4 streams run; after their second chunk, 4
+    more are admitted; gaps on the live streams are recorded
+    throughout.  ``overlap`` toggles ADMIT_OVERLAP (the fix vs the
+    round-3 blocking order)."""
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+
+    os.environ["ADMIT_OVERLAP"] = "1" if overlap else "0"
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.warm()
+    gaps: list[float] = []
+    flowing = None  # set inside body (needs the running loop)
+
+    async def consume_live(gen):
+        last = None
+        n = 0
+        async for chunk in gen:
+            now = time.perf_counter()
+            if last is not None:
+                gaps.append(now - last)
+            last = now
+            n += 1
+            if n == 2:
+                flowing.set()
+
+    async def consume(gen):
+        async for _ in gen:
+            pass
+
+    async def body():
+        nonlocal flowing
+        flowing = asyncio.Event()
+        live = [cdl.submit_stream(dict(feats)) for _ in range(4)]
+        tasks = [asyncio.create_task(consume_live(g)) for g in live]
+        await flowing.wait()
+        late = [cdl.submit_stream(dict(feats)) for _ in range(4)]
+        tasks += [asyncio.create_task(consume(g)) for g in late]
+        await asyncio.gather(*tasks)
+
+    asyncio.run(body())
+    cdl.stop()
+    gaps.sort()
+    n = len(gaps)
+    return {
+        "overlap": overlap,
+        "gaps": n,
+        "p50_ms": round(gaps[n // 2] * 1e3, 1) if n else None,
+        "p99_ms": round(gaps[min(n - 1, int(n * 0.99))] * 1e3, 1) if n else None,
+        "max_ms": round(gaps[-1] * 1e3, 1) if n else None,
+    }
+
+
 def main() -> None:
     device = os.environ.get("DEVICE", "tpu")
     from mlmicroservicetemplate_tpu.runtime.device import apply_device_env
@@ -133,9 +187,15 @@ def main() -> None:
             "speedup": round(cont["tok_s"] / max(legacy["tok_s"], 1e-9), 2),
         })
         print(json.dumps(rows[-1]), flush=True)
+    # Live-stream inter-token latency during admission, fix off vs on.
+    stall = {
+        "blocking": _admission_stall(eng, cfg, feats, overlap=False),
+        "overlapped": _admission_stall(eng, cfg, feats, overlap=True),
+    }
+    print(json.dumps({"admission_stall": stall}), flush=True)
     print(json.dumps({
         "model": MODEL, "decode_len": DECODE, "chunk": CHUNK,
-        "device": device, "rows": rows,
+        "device": device, "rows": rows, "admission_stall": stall,
     }))
 
 

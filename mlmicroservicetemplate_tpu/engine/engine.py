@@ -133,8 +133,33 @@ class InferenceEngine:
                 return state.tokens, state.pos.max()
 
             self._full = jax.jit(full, static_argnums=(5, 6, 7))
+
+            # Speculative decoding (SPEC_DECODE=ngram, models/spec.py):
+            # greedy streams draft spec_k tokens by prompt-lookup and
+            # verify them in one forward — the only lever past the
+            # HBM ceiling at batch=1.  Two executables: a fused
+            # prefill + history-build + first spec chunk (TTFT = one
+            # round-trip, like _start), and the follow-up spec chunk.
+            self.spec_enabled = (
+                getattr(cfg, "spec_decode", None) == "ngram"
+                and bundle.spec_chunk_fn is not None
+            )
+            self.spec_k = int(getattr(cfg, "spec_k", 8))
+            if self.spec_enabled:
+                def spec_start(p, ids, mask, sp, max_len: int,
+                               n_verify: int, spec_k: int):
+                    enc = bundle.encode_fn(p, ids, mask)
+                    state = bundle.init_state_fn(p, enc, mask, max_len, sample=sp)
+                    ss = bundle.init_spec_fn(state, ids, mask)
+                    return bundle.spec_chunk_fn(p, ss, n_verify, spec_k)
+
+                self._spec_start = jax.jit(spec_start, static_argnums=(4, 5, 6))
+                self._spec_chunk = jax.jit(
+                    bundle.spec_chunk_fn, static_argnums=(2, 3)
+                )
         else:
             self._forward = jax.jit(bundle.forward)
+            self.spec_enabled = False
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
         self.last_decode_steps: int | None = None
@@ -267,11 +292,18 @@ class InferenceEngine:
 
     def generate_stream(self, feats: dict) -> Iterator[np.ndarray]:
         """Streaming seq2seq for one request: yields int32 token chunks
-        (``chunk_tokens`` per device dispatch) until EOS or budget."""
+        (``chunk_tokens`` per device dispatch; variable-size chunks of
+        ≥ chunk_tokens on the speculative path) until EOS or budget."""
         import jax
 
         if self.bundle.kind != KIND_SEQ2SEQ:
             raise ValueError(f"{self.bundle.name} does not support streaming")
+        if self.spec_enabled and float(feats.get("temperature", 0.0)) == 0.0:
+            # Greedy streams take the speculative path; sampled ones
+            # fall through (acceptance is an argmax identity — there is
+            # no greedy target to verify against when sampling).
+            yield from self._spec_stream(feats)
+            return
         with self._lock:
             ids, mask, _ = self._collate_text([feats])
             sp, sampled = self._collate_sample([feats], ids.shape[0])
@@ -303,6 +335,52 @@ class InferenceEngine:
             yield chunk
             if done:
                 return
+
+    def _spec_stream(self, feats: dict) -> Iterator[np.ndarray]:
+        """Speculative streaming (greedy): each dispatch runs
+        ``chunk_tokens`` draft→verify rounds, emitting between
+        chunk_tokens and chunk_tokens·(spec_k+1) tokens — token
+        sequence identical to the normal greedy path."""
+        import jax
+
+        from ..models.spec import flatten_emitted
+
+        n_verify = self.chunk_tokens
+        budget = self.budget_for(feats)
+        with self._lock:
+            ids, mask, _ = self._collate_text([feats])
+            sp, _ = self._collate_sample([feats], ids.shape[0])
+            ids, mask = self.replicas.place_batch(ids, mask)
+            ss, out, ns = self._spec_start(
+                self.params, ids, mask, sp,
+                self.max_decode_len, n_verify, self.spec_k,
+            )
+            out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
+        chunk = flatten_emitted(out_np, ns_np, 0)
+        metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
+            int(chunk.size) / max(1, n_verify)
+        )
+        # A verify round can overshoot the budget mid-chunk; trim so the
+        # stream never emits past it (normal-path contract).
+        chunk = chunk[:budget]
+        produced = int(chunk.size)
+        yield chunk
+        done = bool(done_np[0])
+        while not done and produced < budget:
+            with self._lock:
+                ss, out, ns = self._spec_chunk(
+                    self.params, ss, n_verify, self.spec_k
+                )
+                out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
+            chunk = flatten_emitted(out_np, ns_np, 0)
+            metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
+                int(chunk.size) / max(1, n_verify)
+            )
+            chunk = chunk[: budget - produced]
+            produced += int(chunk.size)
+            done = bool(done_np[0])
+            if chunk.size:
+                yield chunk
 
     # ------------------------------------------------------------------
     # warmup: AOT-compile every bucket so p99 never pays a compile
@@ -370,6 +448,21 @@ class InferenceEngine:
                             self.params, state, self.chunk_tokens, flag
                         )
                         jax.device_get(toks)
+                # Speculative start + follow-up chunk compile per seq
+                # bucket too (history/cache shapes depend on it).
+                if self.spec_enabled:
+                    with self._lock:
+                        ids, mask, _ = self._collate_text([feats])
+                        sp, _ = self._collate_sample([feats], ids.shape[0])
+                        ids, mask = self.replicas.place_batch(ids, mask)
+                        ss, out, ns = self._spec_start(
+                            self.params, ids, mask, sp,
+                            self.max_decode_len, self.chunk_tokens, self.spec_k,
+                        )
+                        ss, out, ns = self._spec_chunk(
+                            self.params, ss, self.chunk_tokens, self.spec_k
+                        )
+                        jax.device_get(out)
         dt = time.monotonic() - t0
         log.info("warmup compiled %s buckets in %.1fs", self.bundle.name, dt)
         return dt

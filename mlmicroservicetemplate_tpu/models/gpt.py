@@ -311,6 +311,45 @@ def _decode_step(params: Params, cfg: GPTConfig, state: GPTState, sample: bool =
     return new_state, next_tok
 
 
+def multi_step(
+    params: Params, cfg: GPTConfig, state: GPTState, tokens: jax.Array
+) -> tuple[list, list, jax.Array]:
+    """Window forward for speculative verification (models/spec.py):
+    process D tokens per row at positions write_idx..write_idx+D-1 in
+    ONE pass.  Writes K/V for every window position (cache rows beyond
+    the buffer drop), attends each query to the valid cache PLUS its
+    causal in-window prefix, and returns (new_k, new_v, logits
+    [B, D, V]).  key_valid is NOT updated here — acceptance decides
+    which window positions become real (spec.verify_step)."""
+    dtype = state.cache_k[0].dtype
+    b, d_w = tokens.shape
+    rows = jnp.arange(b)[:, None]  # [B, 1]
+    t = state.write_idx  # [B]
+    pos_w = t[:, None] + jnp.arange(d_w)[None]  # [B, D]
+    x = embed(params["wte"], tokens, dtype)  # [B, D, Dm]
+    x = x + embed(params["wpe"], jnp.minimum(pos_w, cfg.max_position - 1), dtype)
+    total = state.key_valid.shape[1]
+    pos_k = jnp.arange(total)[None, None]  # [1, 1, total]
+    base_valid = (state.key_valid != 0)[:, None, :]  # [B, 1, total]
+    in_window = (pos_k >= t[:, None, None]) & (pos_k <= pos_w[:, :, None])
+    mask = (base_valid | in_window)[:, None]  # [B, 1, D, total]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B, D, H, Dh]
+        ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
+        cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(q, ck, cv, mask=mask)
+        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
+        x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
+    x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
+    return new_k, new_v, _logits(params, cfg, x)  # [B, D, V]
+
+
 def generate_chunk(
     params: Params, cfg: GPTConfig, state: GPTState, n_steps: int, sample: bool = False
 ) -> tuple[GPTState, jax.Array]:

@@ -343,6 +343,53 @@ def _decode_step(params: Params, cfg: LlamaConfig, state: GPTState, sample: bool
     )
 
 
+def multi_step(
+    params: Params, cfg: LlamaConfig, state: GPTState, tokens: jax.Array
+) -> tuple[list, list, jax.Array]:
+    """Window forward for speculative verification (models/spec.py):
+    D tokens per row at positions write_idx.., one pass — the llama
+    variant of ``gpt.multi_step`` (per-row rotary tables at each
+    window position, GQA-width cache writes).  key_valid updates are
+    acceptance's job (spec.verify_step)."""
+    dtype = state.cache_k[0].dtype
+    b, d_w = tokens.shape
+    rows = jnp.arange(b)[:, None]  # [B, 1]
+    t = state.write_idx  # [B]
+    pos_w = t[:, None] + jnp.arange(d_w)[None]  # [B, D]
+    x = embed(params["embed"], tokens, dtype)  # [B, D, Dm]
+    cos, sin = _rope_tables(
+        cfg, jnp.minimum(pos_w, cfg.max_position - 1), dtype
+    )  # [B, D, Dh]
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    total = state.key_valid.shape[1]
+    pos_k = jnp.arange(total)[None, None]
+    base_valid = (state.key_valid != 0)[:, None, :]
+    in_window = (pos_k >= t[:, None, None]) & (pos_k <= pos_w[:, :, None])
+    mask = (base_valid | in_window)[:, None]  # [B, 1, D, total]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
+        a = layer["attn"]
+        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
+        cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(
+            q, _repeat_kv(ck, cfg.n_rep), _repeat_kv(cv, cfg.n_rep), mask=mask
+        )
+        x = x + dense(a["o"], merge_heads(ctx))
+        h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
+        m = layer["mlp"]
+        x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
+    x = rmsnorm(params["final_ln"], x, eps=cfg.rms_eps)
+    logits = lm_head_logits(x, params["lm_head"]["kernel"], transposed=False)
+    return new_k, new_v, logits  # [B, D, V]
+
+
 def generate_chunk(
     params: Params, cfg: LlamaConfig, state: GPTState, n_steps: int, sample: bool = False
 ) -> tuple[GPTState, jax.Array]:

@@ -60,6 +60,14 @@ class ModelBundle:
     # Whether this family consumed cfg.prompt_prefix (cached system-
     # prompt KV); build_model rejects the knob when unsupported.
     supports_prefix: bool = False
+    # Speculative decoding (decoder-only families; models/spec.py):
+    # init_spec_fn(gpt_state, ids, mask) -> SpecState builds the
+    # drafting history; spec_chunk_fn(params, spec_state, n_verify,
+    # spec_k) -> (SpecState, out [B,nv,K+1], n_emit [B,nv]) runs
+    # n_verify draft→verify rounds in one dispatch.  None = family
+    # does not support SPEC_DECODE.
+    init_spec_fn: Callable | None = None
+    spec_chunk_fn: Callable | None = None
 
     # -- host-side single-item pre/post ------------------------------------
     def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
@@ -533,6 +541,18 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return gpt_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
+    from . import spec as spec_mod
+
+    def init_spec_fn(state, input_ids, attention_mask):
+        return spec_mod.init_history(state, input_ids, attention_mask, p_len)
+
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+        return spec_mod.spec_chunk(
+            p, spec_state, n_verify, spec_k, int(svc_cfg.spec_ngram),
+            lambda pp, st, toks: gpt_mod.multi_step(pp, cfg, st, toks),
+            cfg.eos_id, cfg.pad_id,
+        )
+
     return ModelBundle(
         name="gpt2",
         kind=KIND_SEQ2SEQ,
@@ -549,6 +569,8 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         # TP=<n>: decoder Megatron sharding (parallel/tp.py gpt spec).
         make_placement=_tp_placement(svc_cfg, cfg, "gpt"),
         supports_prefix=True,
+        init_spec_fn=init_spec_fn,
+        spec_chunk_fn=spec_chunk_fn,
     )
 
 
@@ -635,6 +657,18 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return llama_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
+    from . import spec as spec_mod
+
+    def init_spec_fn(state, input_ids, attention_mask):
+        return spec_mod.init_history(state, input_ids, attention_mask, p_len)
+
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+        return spec_mod.spec_chunk(
+            p, spec_state, n_verify, spec_k, int(svc_cfg.spec_ngram),
+            lambda pp, st, toks: llama_mod.multi_step(pp, cfg, st, toks),
+            cfg.eos_id, cfg.pad_id,
+        )
+
     return ModelBundle(
         name="llama",
         kind=KIND_SEQ2SEQ,
@@ -650,6 +684,8 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         max_prompt_len=max_prompt,
         make_placement=_tp_placement(svc_cfg, cfg, "llama"),
         supports_prefix=True,
+        init_spec_fn=init_spec_fn,
+        spec_chunk_fn=spec_chunk_fn,
     )
 
 
@@ -714,5 +750,12 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
         raise ValueError(
             f"PROMPT_PREFIX is not supported for {svc_cfg.model_name!r} "
             "(cached-prefix serving covers the decoder families: gpt2, llama)"
+        )
+    # Same rule for SPEC_DECODE: an operator who turned it on must not
+    # silently serve without it (zero speedup, no metric, no error).
+    if getattr(svc_cfg, "spec_decode", None) and bundle.spec_chunk_fn is None:
+        raise ValueError(
+            f"SPEC_DECODE is not supported for {svc_cfg.model_name!r} "
+            "(speculative decoding covers the decoder families: gpt2, llama)"
         )
     return bundle

@@ -1,0 +1,233 @@
+"""Self-drafting speculative decoding (prompt-lookup / n-gram).
+
+At batch=1 a decoder's step time is pinned to the HBM ceiling: every
+token streams the full weight set once (measured in BASELINE.md —
+llama-1.1B at 2.58 ms/step bf16 ≈ 853 GB/s, the v5e wire).  No tuning
+beats that wall except not paying one weight pass PER token: draft
+several candidate tokens cheaply, then verify them all in ONE forward
+whose weight traffic is the same as a single step.  With m drafts
+accepted, one weight pass yields m+1 tokens.
+
+This module is the drafter-free variant (no second checkpoint exists in
+this offline environment): drafts come from *prompt lookup* — the last
+``ngram_n`` generated tokens are matched against the prompt + generation
+history, and the ``spec_k`` tokens that followed the most recent match
+become the draft.  Free to compute (a masked compare over an int32
+buffer already on device), highly effective whenever output re-uses
+input spans (summarization, extraction, code edits, chat quoting), and
+harmless when it misses: a rejected draft costs only MXU idle lanes in
+the verify forward, which is HBM-bound at these shapes anyway.
+
+Correctness contract (greedy only): every emitted token equals the
+verify forward's own greedy argmax at its position, so the output
+token sequence is EXACTLY what non-speculative greedy decoding would
+produce under the same numerics (tested token-identical in
+tests/test_spec.py).  Acceptance never depends on where a draft came
+from — a garbage draft that happens to match argmax is a correct
+emission by construction.
+
+All control flow is static-shape: each verify step processes a fixed
+``spec_k + 1`` token window and returns a fixed-width output row plus a
+per-row valid count; the host slices counts off the fetched buffer.
+Works on any decoder family exposing a ``multi_step`` window forward
+(gpt.py, llama.py — the GPTState contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SpecState(NamedTuple):
+    """Decode state + token history for drafting.
+
+    ``base`` is the family's GPTState (per-row caches/write_idx/done —
+    models/gpt.py); ``history`` is an int32 [B, total] buffer where
+    position p holds the token id EMBEDDED at cache position p (-1
+    where no real token lives: bucket padding, unwritten future, the
+    startup-cached PROMPT_PREFIX region whose ids were never seen
+    here).  Invariant: history[b, write_idx[b]] == last_token[b]."""
+
+    base: Any
+    history: jax.Array
+
+
+def init_history(state, input_ids, attention_mask, p_len: int) -> SpecState:
+    """Build the drafting history from the (right-padded) prompt.
+
+    ``p_len`` is the cached-prefix length (prefix ids are unknown at
+    this layer — that region stays -1, which simply means no n-gram
+    matches land there)."""
+    b, s = input_ids.shape
+    total = state.key_valid.shape[1]
+    hist = jnp.full((b, total), -1, jnp.int32)
+    ids = jnp.where(attention_mask != 0, input_ids, -1).astype(jnp.int32)
+    hist = hist.at[:, p_len : p_len + s].set(ids)
+    return SpecState(base=state, history=hist)
+
+
+def draft_ngram(
+    history: jax.Array,  # [B, total] int32, -1 invalid
+    write_idx: jax.Array,  # [B]
+    spec_k: int,
+    ngram_n: int,
+) -> jax.Array:
+    """Prompt-lookup draft: [B, spec_k] continuation of the most recent
+    earlier occurrence of the last ``ngram_n`` tokens; -1 rows where no
+    match exists (-1 never equals an argmax, so unmatched drafts are
+    rejected for free)."""
+    b, total = history.shape
+    posv = jnp.arange(total)[None]  # [1, total]
+    t = write_idx[:, None]  # [B, 1]
+    # Candidate match position j: history[j-d] == history[t-d] for all
+    # d < ngram_n, strictly before the current position.
+    cand = (posv < t) & (posv >= ngram_n - 1)
+    for d in range(ngram_n):
+        tgt = jnp.take_along_axis(
+            history, jnp.clip(t - d, 0, total - 1), axis=1
+        )  # [B, 1]
+        if d == 0:
+            hd = history
+        else:
+            hd = jnp.pad(
+                history[:, :-d], ((0, 0), (d, 0)), constant_values=-1
+            )
+        cand = cand & (hd == tgt) & (tgt >= 0)
+    # Most recent match wins (closest context beats an older span).
+    j = jnp.where(cand, posv, -1).max(axis=1)  # [B], -1 = no match
+    gather = jnp.clip(
+        j[:, None] + 1 + jnp.arange(spec_k)[None], 0, total - 1
+    )
+    draft = jnp.take_along_axis(history, gather, axis=1)  # [B, spec_k]
+    return jnp.where(j[:, None] >= 0, draft, jnp.int32(-1))
+
+
+def verify_step(
+    params,
+    spec_state: SpecState,
+    spec_k: int,
+    ngram_n: int,
+    multi_fn: Callable,  # (params, base_state, tokens [B,D]) -> (k, v, logits [B,D,V])
+    eos_id: int,
+    pad_id: int,
+):
+    """One draft→verify→accept round.  Returns (state', out [B, K+1],
+    n_emit [B]): ``out[:, :n_emit]`` are the emitted tokens (padded with
+    pad_id past the count).
+
+    Window semantics: input x_0 = last_token (recomputed at its own
+    position, identical to the single-step path's uniform-step trick),
+    x_1..x_K = draft.  g_i = argmax of the logits after x_i.  g_0 is
+    unconditionally correct (it is THE next greedy token); draft_i is
+    accepted iff it equals g_i's predecessor chain — the longest prefix
+    where draft == g[:, :K] — because only then was x_{i+1} the token
+    greedy would have fed next.  m accepted drafts ⇒ m+1 emitted tokens
+    (the bonus token g_m comes free from the verify logits).
+
+    Cache/state discipline: K/V for ALL window positions are written
+    before acceptance is known; only accepted positions get key_valid
+    set, so rejected-position K/V is invisible and gets overwritten by
+    later (sequential) writes before its position is ever marked valid.
+    Rows already done emit nothing and freeze (their writes re-write
+    position t with identical values)."""
+    st = spec_state.base
+    hist = spec_state.history
+    b = st.last_token.shape[0]
+    width = spec_k + 1
+    rows = jnp.arange(b)[:, None]  # [B, 1]
+    offs = jnp.arange(width)[None]  # [1, width]
+
+    draft = draft_ngram(hist, st.write_idx, spec_k, ngram_n)
+    tokens = jnp.concatenate([st.last_token[:, None], draft], axis=1)
+    # Draft slots may hold -1 (no match): embedding lookups need a real
+    # id — feed pad instead; acceptance still compares the RAW draft,
+    # so these can never be accepted.
+    feed = jnp.where(tokens >= 0, tokens, jnp.int32(pad_id))
+    new_k, new_v, logits = multi_fn(params, st, feed)
+    g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, width]
+
+    match = draft == g[:, :spec_k]
+    # Longest accepted prefix: count of leading True.
+    m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)  # [B]
+    emit_raw = offs <= m[:, None]  # candidates g_0..g_m
+    is_eos = (g == jnp.int32(eos_id)) & emit_raw
+    has_eos = is_eos.any(axis=1)
+    eos_idx = jnp.where(has_eos, jnp.argmax(is_eos, axis=1), width)
+    # Emit through the first EOS inclusive, like the sequential path.
+    n_emit = jnp.minimum(m + 1, eos_idx + 1)
+    n_emit = jnp.where(st.done, 0, n_emit).astype(jnp.int32)
+    emit = offs < n_emit[:, None]  # [B, width]
+    out = jnp.where(emit, g, jnp.int32(pad_id))
+
+    total = st.key_valid.shape[1]
+    sentinel_tok = st.tokens.shape[1]  # OOB ⇒ mode="drop"
+    tokens_buf = st.tokens.at[
+        rows, jnp.where(emit, st.pos[:, None] + offs, sentinel_tok)
+    ].set(out, mode="drop")
+    posv = jnp.arange(total)[None]
+    newly_valid = (posv >= st.write_idx[:, None]) & (
+        posv < (st.write_idx + n_emit)[:, None]
+    )
+    key_valid = jnp.where(newly_valid, 1, st.key_valid)
+    # Token g_i will be embedded at position t+1+i (history invariant).
+    hist = hist.at[
+        rows, jnp.where(emit, st.write_idx[:, None] + 1 + offs, total)
+    ].set(out, mode="drop")
+    last = jnp.where(
+        n_emit > 0,
+        jnp.take_along_axis(g, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
+        st.last_token,
+    )
+    base = st._replace(
+        cache_k=new_k,
+        cache_v=new_v,
+        key_valid=key_valid,
+        write_idx=st.write_idx + n_emit,
+        pos=st.pos + n_emit,
+        last_token=last,
+        done=st.done | has_eos,
+        tokens=tokens_buf,
+    )
+    return SpecState(base=base, history=hist), out, n_emit
+
+
+def spec_chunk(
+    params,
+    spec_state: SpecState,
+    n_verify: int,
+    spec_k: int,
+    ngram_n: int,
+    multi_fn: Callable,
+    eos_id: int,
+    pad_id: int,
+):
+    """``n_verify`` verify rounds in one compiled scan — the spec-path
+    chunk contract.  Returns (state', out [B, n_verify, K+1], n_emit
+    [B, n_verify]): each round emits between 1 and K+1 tokens per live
+    row (0 once done), so one dispatch yields ≥ n_verify tokens and up
+    to n_verify·(K+1)."""
+
+    def step(s, _):
+        s2, out, n = verify_step(
+            params, s, spec_k, ngram_n, multi_fn, eos_id, pad_id
+        )
+        return s2, (out, n)
+
+    spec_state, (outs, ns) = jax.lax.scan(
+        step, spec_state, None, length=n_verify
+    )
+    return spec_state, jnp.transpose(outs, (1, 0, 2)), jnp.transpose(ns)
+
+
+def flatten_emitted(out_np, n_np, row: int = 0):
+    """Host-side: ordered emitted tokens for one row from a fetched
+    (out [B, n_verify, K+1], n_emit [B, n_verify]) pair."""
+    import numpy as np
+
+    parts = [
+        out_np[row, v, : int(n_np[row, v])] for v in range(out_np.shape[1])
+    ]
+    return np.concatenate(parts) if parts else np.zeros((0,), np.int32)

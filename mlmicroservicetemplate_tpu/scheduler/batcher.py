@@ -129,7 +129,25 @@ class Batcher:
         half-consumed) generator cannot leak a slot."""
         if self._closed:
             raise RuntimeError("batcher is stopped")
-        if self._cdl is not None and int(feats.get("length", 0)) <= self._cdl.max_prompt:
+        # SPEC_DECODE routes greedy streams to the per-stream path
+        # (where the speculative executables live) ONLY in the
+        # low-concurrency regime it targets (< spec_max_streams
+        # active): under load, one shared batched dispatch for all
+        # streams beats N serialized speculative loops, so traffic
+        # falls back to the continuous loop.  Sampled streams (no
+        # greedy target to verify) always keep the shared loop.
+        cdl_admitted = self._cdl._admitted if self._cdl is not None else 0
+        spec_route = (
+            getattr(self.engine, "spec_enabled", False)
+            and float(feats.get("temperature", 0.0)) == 0.0
+            and (self._active_streams + cdl_admitted)
+            < int(getattr(self.engine.cfg, "spec_max_streams", 1))
+        )
+        if (
+            self._cdl is not None
+            and not spec_route
+            and int(feats.get("length", 0)) <= self._cdl.max_prompt
+        ):
             return self._cdl.submit_stream(feats)
         # Oversized prompts (longer than the largest seq bucket) cannot
         # join the shared slot batch; they keep the per-stream path —

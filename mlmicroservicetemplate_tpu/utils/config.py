@@ -100,6 +100,27 @@ class ServiceConfig(BaseModel):
     # step — the lever for HBM-bound small-batch generation).
     quantize: str | None = None
 
+    # Speculative decoding for decoder-only families (gpt2/llama):
+    # "ngram" drafts the next SPEC_K tokens by prompt-lookup (the last
+    # SPEC_NGRAM generated tokens are matched against the prompt +
+    # generation history) and verifies all of them in ONE forward —
+    # the only lever past the HBM ceiling at batch=1, where each step
+    # otherwise streams the full weights for one token.  Greedy streams
+    # only (sampled requests fall back to normal decode); emitted
+    # tokens are exactly the verify-forward's greedy argmax at every
+    # position, so output == non-speculative greedy.
+    spec_decode: str | None = None
+    # Draft length per verify step (tokens checked per forward).
+    spec_k: int = 8
+    # Match-pattern length for the n-gram lookup.
+    spec_ngram: int = 2
+    # Load gate: greedy streams route to the speculative per-stream
+    # path only while FEWER than this many streams are active; beyond
+    # it they join the shared continuous-batching loop instead (one
+    # batched dispatch for all streams beats per-stream speculation
+    # under concurrency — speculation is the B=1 latency lever).
+    spec_max_streams: int = 1
+
     # Shared prompt prefix (system prompt) for decoder models
     # (gpt2/llama): its KV is computed ONCE at startup and cached, so
     # every request's prefill pays only its own suffix (O(S) instead
@@ -118,6 +139,33 @@ class ServiceConfig(BaseModel):
                 return None
             if v != "int8":
                 raise ValueError(f"QUANTIZE must be 'int8' or unset, got {v!r}")
+        return v
+
+    @field_validator("spec_decode")
+    @classmethod
+    def _check_spec(cls, v: str | None) -> str | None:
+        if v is not None:
+            v = v.lower()
+            if v in ("", "none", "off", "0", "false"):
+                return None
+            if v != "ngram":
+                raise ValueError(
+                    f"SPEC_DECODE must be 'ngram' or unset, got {v!r}"
+                )
+        return v
+
+    @field_validator("spec_k")
+    @classmethod
+    def _check_spec_k(cls, v: int) -> int:
+        if not (1 <= v <= 64):
+            raise ValueError("SPEC_K must be in [1, 64]")
+        return v
+
+    @field_validator("spec_ngram")
+    @classmethod
+    def _check_spec_ngram(cls, v: int) -> int:
+        if not (1 <= v <= 8):
+            raise ValueError("SPEC_NGRAM must be in [1, 8]")
         return v
 
     @field_validator("device")
@@ -149,7 +197,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       MAX_BATCH, BATCH_TIMEOUT_MS, MAX_QUEUE, REPLICAS, SP, TP,
       MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
       MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS, QUANTIZE,
-      REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX.
+      REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX,
+      SPEC_DECODE, SPEC_K, SPEC_NGRAM.
     """
     e = dict(os.environ)
     if env:
@@ -170,6 +219,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "log_level": "LOG_LEVEL",
         "quantize": "QUANTIZE",
         "prompt_prefix": "PROMPT_PREFIX",
+        "spec_decode": "SPEC_DECODE",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -185,6 +235,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "max_decode_len": "MAX_DECODE_LEN",
         "pipeline_depth": "PIPELINE_DEPTH",
         "max_streams": "MAX_STREAMS",
+        "spec_k": "SPEC_K",
+        "spec_ngram": "SPEC_NGRAM",
+        "spec_max_streams": "SPEC_MAX_STREAMS",
     }
     for field, var in int_mapping.items():
         v = get(var)

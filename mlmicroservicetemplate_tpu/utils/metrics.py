@@ -84,6 +84,12 @@ DECODE_STEPS = Histogram(
     "(< max_decode_len when the whole batch hit EOS early)",
     ["model"], buckets=(4, 8, 16, 32, 64, 128, 256),
 )
+SPEC_EMITTED = Histogram(
+    "spec_tokens_per_verify_step",
+    "Speculative decoding: tokens emitted per verify step (1.0 = no "
+    "draft accepted; the acceptance-rate observability surface)",
+    ["model"], buckets=(1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 9.0),
+)
 
 
 def render() -> tuple[bytes, str]:
