@@ -795,6 +795,8 @@ async def handle_status(request: web.Request) -> web.Response:
         warns = app[K_STATE].get("chat_template_warnings") or []
         if warns:
             body["chat_template_warnings"] = warns
+        if getattr(engine, "prefix_cache", None) is not None:
+            body["prefix_cache"] = engine.prefix_cache.stats()
     return web.json_response(body)
 
 

@@ -23,7 +23,7 @@ import math
 import os
 import threading
 import time
-from typing import Iterator
+from typing import Any, Iterator
 
 import numpy as np
 
@@ -157,9 +157,45 @@ class InferenceEngine:
                 self._spec_chunk = jax.jit(
                     bundle.spec_chunk_fn, static_argnums=(2, 3)
                 )
+
+            # Per-request prefix cache (PREFIX_CACHE=1, decoder
+            # families without a global PROMPT_PREFIX): recurring
+            # prompt prefixes — per-conversation system prompt +
+            # history — donate their KV at prefill and later requests
+            # prefill only their suffix.  The cached KV rides as a
+            # TRACED argument (one executable per prefix-bucket ×
+            # suffix-bucket pair), entering the model through the same
+            # ``__prefix__`` overlay the global knob uses.
+            self.prefix_cache = None
+            if (
+                getattr(cfg, "prefix_cache", False)
+                and bundle.supports_prefix
+                and not (
+                    isinstance(bundle.params, dict)
+                    and "__prefix__" in bundle.params
+                )
+            ):
+                from .prefix_cache import PrefixCache
+
+                self.prefix_cache = PrefixCache(
+                    self.seq_buckets, float(getattr(cfg, "prefix_cache_mb", 256.0))
+                )
+
+                def start_prefixed(p, pkv, ids, mask, sp, max_len: int,
+                                   n_steps: int, sample: bool):
+                    p2 = dict(p, __prefix__=pkv)
+                    enc = bundle.encode_fn(p2, ids, mask)
+                    state = bundle.init_state_fn(p2, enc, mask, max_len, sample=sp)
+                    return bundle.generate_chunk_fn(p2, state, n_steps, sample)
+
+                self._start_prefixed = jax.jit(
+                    start_prefixed, static_argnums=(5, 6, 7)
+                )
+                self._slice_prefix: dict[int, Any] = {}
         else:
             self._forward = jax.jit(bundle.forward)
             self.spec_enabled = False
+            self.prefix_cache = None
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
         self.last_decode_steps: int | None = None
@@ -290,6 +326,99 @@ class InferenceEngine:
             rows = np.asarray(jax.device_get(logits))
         return [rows[i] for i in range(n)]
 
+    def start_fused(self, feats: dict):
+        """Collate + fused prefill-and-first-chunk for ONE stream,
+        through the per-request prefix cache when it hits.  Returns
+        (state, toks, sampled).  Caller must hold ``self._lock``.
+
+        Cache-hit path: the prompt's longest cached prefix (exact
+        token-hash match at a seq-bucket length P) rides in as KV and
+        only the suffix prefills — O(S) not O(P+S), per request.
+        Miss path: normal full prefill, after which the prompt DONATES
+        its own prefix KV (a single jitted slice of cache rows 0..P —
+        free compute, the prefill already produced it)."""
+        row_ids = np.asarray(feats["input_ids"], np.int32)[: int(feats["length"])]
+        length = int(feats["length"])
+        s_max = max(self.seq_buckets)
+        max_pos = int(getattr(self.bundle.cfg, "max_position", 1 << 30))
+
+        def usable(p_len: int) -> bool:
+            # Static-shape guards: the padded suffix bucket must keep
+            # positions inside the table AND the combined width inside
+            # the continuous loop's max-bucket slots.
+            s_suf = bucket_for(
+                max(length - p_len, 1), self.seq_buckets,
+                self.replicas.seq_multiple(),
+            )
+            return (
+                p_len + s_suf <= s_max
+                and p_len + s_suf + self.max_decode_len <= max_pos
+            )
+
+        if self.prefix_cache is not None:
+            m = self.prefix_cache.match(row_ids, length, usable=usable)
+            if m is not None:
+                p_len, pkv = m
+                sfeats = dict(
+                    feats,
+                    input_ids=row_ids[p_len:],
+                    length=np.int32(length - p_len),
+                )
+                ids, mask, _ = self._collate_text([sfeats])
+                sp, sampled = self._collate_sample([feats], ids.shape[0])
+                ids, mask = self.replicas.place_batch(ids, mask)
+                state, toks = self._start_prefixed(
+                    self.params, pkv, ids, mask, sp,
+                    self.max_decode_len, self.chunk_tokens, sampled,
+                )
+                # A growing conversation must keep donating: the hit
+                # state's cache holds the full contiguous prefix+suffix
+                # KV, so capture at the LARGEST bucket this prompt now
+                # covers — otherwise turn N stays pinned to turn 1's
+                # bucket and re-prefills an ever-growing suffix.
+                p_ins = self.prefix_cache.bucket_for_insert(length)
+                if (
+                    p_ins is not None
+                    and p_ins > p_len
+                    and not self.prefix_cache.contains(row_ids, p_ins)
+                ):
+                    self.prefix_cache.insert(
+                        row_ids, p_ins, self._capture_prefix(state, p_ins)
+                    )
+                return state, toks, sampled
+        ids, mask, _ = self._collate_text([feats])
+        sp, sampled = self._collate_sample([feats], ids.shape[0])
+        ids, mask = self.replicas.place_batch(ids, mask)
+        state, toks = self._start(
+            self.params, ids, mask, sp,
+            self.max_decode_len, self.chunk_tokens, sampled,
+        )
+        if self.prefix_cache is not None:
+            p_ins = self.prefix_cache.bucket_for_insert(length)
+            if p_ins is not None and not self.prefix_cache.contains(
+                row_ids, p_ins
+            ):
+                self.prefix_cache.insert(
+                    row_ids, p_ins, self._capture_prefix(state, p_ins)
+                )
+        return state, toks, sampled
+
+    def _capture_prefix(self, state, p_len: int):
+        """Prefix KV from a fresh prefill's cache rows [0, p_len) —
+        one jitted slice dispatch, shaped like compute_prefix_kv's
+        pytree so ``__prefix__`` consumers take it unchanged."""
+        import jax
+
+        if p_len not in self._slice_prefix:
+            def slc(st):
+                return {
+                    "k": [c[:1, :p_len] for c in st.cache_k],
+                    "v": [c[:1, :p_len] for c in st.cache_v],
+                }
+
+            self._slice_prefix[p_len] = jax.jit(slc)
+        return self._slice_prefix[p_len](state)
+
     def generate_stream(self, feats: dict) -> Iterator[np.ndarray]:
         """Streaming seq2seq for one request: yields int32 token chunks
         (``chunk_tokens`` per device dispatch; variable-size chunks of
@@ -305,14 +434,9 @@ class InferenceEngine:
             yield from self._spec_stream(feats)
             return
         with self._lock:
-            ids, mask, _ = self._collate_text([feats])
-            sp, sampled = self._collate_sample([feats], ids.shape[0])
-            ids, mask = self.replicas.place_batch(ids, mask)
-            # First chunk fused with encode+init: TTFT = one round-trip.
-            state, toks = self._start(
-                self.params, ids, mask, sp,
-                self.max_decode_len, self.chunk_tokens, sampled,
-            )
+            # First chunk fused with encode+init (and routed through
+            # the per-request prefix cache): TTFT = one round-trip.
+            state, toks, sampled = self.start_fused(feats)
             # One transfer for tokens+done — each device_get pays a full
             # relay round-trip, so never fetch them separately.
             toks_np, done_np = jax.device_get((toks, state.done))
@@ -448,6 +572,59 @@ class InferenceEngine:
                             self.params, state, self.chunk_tokens, flag
                         )
                         jax.device_get(toks)
+                # Prefix-cache executables: capture slicers for every
+                # (prompt-bucket, prefix-bucket) pair — misses at ANY
+                # bucket donate on-path — plus the (prefix × suffix)
+                # _start_prefixed grid in both greedy and (when warmed)
+                # sampled variants, so no cache interaction ever
+                # compiles on the request path.
+                if self.prefix_cache is not None:
+                    with self._lock:
+                        ids, mask, _ = self._collate_text([feats])
+                        sp, _ = self._collate_sample([feats], ids.shape[0])
+                        ids, mask = self.replicas.place_batch(ids, mask)
+                        template, _ = self._start(
+                            self.params, ids, mask, sp,
+                            self.max_decode_len, self.chunk_tokens, False,
+                        )
+                        for p_len in self.seq_buckets:
+                            if p_len > s - 1:
+                                continue
+                            pkv = self._capture_prefix(template, p_len)
+                            if s != max(self.seq_buckets):
+                                # The _start_prefixed grid only needs
+                                # warming once (pkv shapes depend on
+                                # p_len alone); smaller prompt buckets
+                                # just warm their capture slicer above.
+                                continue
+                            for s_suf in self.seq_buckets:
+                                if p_len + s_suf > max(self.seq_buckets):
+                                    continue
+                                sfeats = {
+                                    "input_ids": np.ones(s_suf, np.int32),
+                                    "length": np.int32(s_suf),
+                                }
+                                sids, smask, _ = self._collate_text([sfeats])
+                                ssp, _ = self._collate_sample(
+                                    [sfeats], sids.shape[0]
+                                )
+                                sids, smask = self.replicas.place_batch(
+                                    sids, smask
+                                )
+                                for flag in sampled_variants:
+                                    st2, toks2 = self._start_prefixed(
+                                        self.params, pkv, sids, smask, ssp,
+                                        self.max_decode_len,
+                                        self.chunk_tokens, flag,
+                                    )
+                                    jax.device_get(toks2)
+                                # Hit-path donation slicers: a cache
+                                # hit captures a LARGER prefix from its
+                                # own (narrower) state — warm those
+                                # state-shape variants too.
+                                for p_ins in self.seq_buckets:
+                                    if p_len < p_ins <= p_len + s_suf - 1:
+                                        self._capture_prefix(st2, p_ins)
                 # Speculative start + follow-up chunk compile per seq
                 # bucket too (history/cache shapes depend on it).
                 if self.spec_enabled:

@@ -1,0 +1,134 @@
+"""Per-request prompt-prefix KV cache (the vLLM-class feature the
+global PROMPT_PREFIX knob approximates).
+
+Real chat traffic shares prefixes PER CONVERSATION — system prompt +
+growing history — not one global system prompt.  This cache lets every
+request reuse the KV of the longest previously-computed prefix of its
+own token sequence: TTFT then pays only the suffix prefill, the same
+O(S)-not-O(P+S) economics the global knob measured at 1.52× on
+llama-1.1B (BASELINE.md round 3), but granted at request time to any
+recurring prefix.
+
+TPU-first constraints shape the design:
+
+- **Static shapes**: a cached prefix's length P selects an XLA
+  executable, so P is quantized to the engine's existing seq buckets —
+  the executable grid stays |seq_buckets|² at worst, warmable, and a
+  request matches the LARGEST bucket P ≤ len(prompt)-1 whose token
+  hash hits (≥1 real suffix token must remain: generation needs it).
+- **Keys are content hashes** of the exact token ids
+  (blake2b(tokens[:P])), so a hit is exact-prefix identity — no
+  false sharing between conversations.
+- **Capture is free compute**: after any full prefill, cache rows
+  0..P already hold the prefix KV — insertion is ONE jitted slice
+  dispatch of [1, P] per layer stack, not a recompute.
+- **No hard refcounts needed**: JAX arrays are immutable, so an
+  in-flight request keeps its prefix arrays alive past eviction; the
+  LRU byte budget (``PREFIX_CACHE_MB``) bounds what the CACHE pins,
+  not what requests hold.
+
+Mutually exclusive with the global PROMPT_PREFIX (its KV occupies
+positions 0..P_global, which per-request prefixes would collide with);
+the engine enables this cache only when no global prefix is attached.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def _key(ids: np.ndarray, p: int) -> bytes:
+    return hashlib.blake2b(
+        np.ascontiguousarray(ids[:p].astype(np.int32)).tobytes(), digest_size=16
+    ).digest()
+
+
+class PrefixCache:
+    """LRU {(P, hash(tokens[:P])) -> per-layer KV pytree [1, P, H, D]}."""
+
+    def __init__(self, buckets: tuple[int, ...], budget_mb: float = 256.0):
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.budget_bytes = int(budget_mb * 1e6)
+        self._entries: OrderedDict[tuple[int, bytes], Any] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def match(self, ids: np.ndarray, length: int, usable=None):
+        """Longest cached prefix of ``ids[:length]``: (P, kv) or None.
+        P ≤ length-1 so at least one real token remains to prefill.
+
+        ``usable(P) -> bool`` lets the caller impose its static-shape
+        guards BEFORE a candidate counts: an entry the engine cannot
+        actually serve from must not register a hit or get LRU-promoted
+        (it would skew stats and evict genuinely-serving entries)."""
+        with self._lock:
+            for p in reversed(self.buckets):
+                if p > length - 1 or (usable is not None and not usable(p)):
+                    continue
+                key = (p, _key(ids, p))
+                kv = self._entries.get(key)
+                if kv is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return p, kv
+            self.misses += 1
+            return None
+
+    def bucket_for_insert(self, length: int) -> int | None:
+        """Largest bucket ≤ length-1 (the most reusable prefix a prompt
+        of this length can donate), or None when it's too short."""
+        cands = [p for p in self.buckets if p <= length - 1]
+        return max(cands) if cands else None
+
+    def contains(self, ids: np.ndarray, p: int) -> bool:
+        with self._lock:
+            return (p, _key(ids, p)) in self._entries
+
+    def insert(self, ids: np.ndarray, p: int, kv: Any) -> None:
+        """Store prefix KV (a pytree of device arrays); LRU-evict past
+        the byte budget.  Evicted arrays stay alive for any in-flight
+        request that already fetched them (immutability)."""
+        import jax
+
+        nbytes = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree.leaves(kv)
+        )
+        key = (p, _key(ids, p))
+        with self._lock:
+            if key in self._entries:
+                return
+            self._entries[key] = kv
+            self._bytes += nbytes
+            while self._bytes > self.budget_bytes and len(self._entries) > 1:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= sum(
+                    int(np.prod(x.shape)) * x.dtype.itemsize
+                    for x in jax.tree.leaves(old)
+                )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }

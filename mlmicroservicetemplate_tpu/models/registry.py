@@ -758,4 +758,31 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
             f"SPEC_DECODE is not supported for {svc_cfg.model_name!r} "
             "(speculative decoding covers the decoder families: gpt2, llama)"
         )
+    if getattr(svc_cfg, "prefix_cache", False):
+        if not bundle.supports_prefix:
+            raise ValueError(
+                f"PREFIX_CACHE is not supported for {svc_cfg.model_name!r} "
+                "(per-request prefix caching covers the decoder "
+                "families: gpt2, llama)"
+            )
+        if getattr(svc_cfg, "prompt_prefix", None):
+            raise ValueError(
+                "PREFIX_CACHE and PROMPT_PREFIX are mutually exclusive: "
+                "the global prefix occupies positions 0..P that "
+                "per-request prefixes need (the cache generalizes the "
+                "global knob — drop PROMPT_PREFIX)"
+            )
+        if getattr(svc_cfg, "spec_decode", None):
+            # Not an error — the two compose across the traffic mix
+            # (sampled + loop-admitted streams still hit the cache) —
+            # but the B=1 greedy requests SPEC_DECODE routes to the
+            # speculative path bypass the cache entirely, and that is
+            # exactly the traffic both knobs target.  Loud, not silent.
+            log.warning(
+                "SPEC_DECODE + PREFIX_CACHE: greedy streams below "
+                "SPEC_MAX_STREAMS take the speculative path, which does "
+                "not use the per-request prefix cache — their TTFT "
+                "pays full prefill; sampled and concurrent streams "
+                "still get cache hits"
+            )
     return bundle

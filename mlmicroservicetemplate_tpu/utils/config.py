@@ -84,6 +84,14 @@ class ServiceConfig(BaseModel):
     # dispatch, new streams admitted at chunk boundaries
     # (engine/streams.py).  Off = round-2 per-stream workers.
     continuous_batching: bool = True
+    # Chunk-chain pipelining depth for the continuous loop: how many
+    # batched chunk dispatches ride in flight before the oldest is
+    # fetched.  The state chain is pure device-side, so depth D cuts
+    # the steady-state inter-chunk cadence to ~max(RTT/D, chunk
+    # compute).  0 = auto: measured at warmup from dispatch RTT vs
+    # per-chunk device time (the relay regime picks ~RTT/compute,
+    # a directly-attached chip picks 1).
+    stream_pipeline: int = 0
 
     # Parent orchestration-server registration (template parity:
     # the public template self-registers with a Photo Analysis Server on
@@ -126,6 +134,16 @@ class ServiceConfig(BaseModel):
     # every request's prefill pays only its own suffix (O(S) instead
     # of O(P+S)) and the prefix never counts against wire bytes.
     prompt_prefix: str | None = None
+    # PER-REQUEST prefix caching (decoder families; the vLLM-class
+    # generalization of PROMPT_PREFIX): KV of recurring token prefixes
+    # — per-conversation system prompt + history — is captured from
+    # each prefill and reused by any later request sharing it, matched
+    # at request time by content hash at seq-bucket lengths.  Opt-in:
+    # it compiles a (prefix-bucket × suffix-bucket) executable grid at
+    # warmup, so restrict SEQ_BUCKETS for these deployments.
+    # Mutually exclusive with PROMPT_PREFIX.
+    prefix_cache: bool = False
+    prefix_cache_mb: float = 256.0
 
     # Observability.
     log_level: str = "INFO"
@@ -238,6 +256,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "spec_k": "SPEC_K",
         "spec_ngram": "SPEC_NGRAM",
         "spec_max_streams": "SPEC_MAX_STREAMS",
+        "stream_pipeline": "STREAM_PIPELINE",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -264,4 +283,10 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("CONTINUOUS_BATCHING")
     if v is not None:
         kwargs["continuous_batching"] = v.lower() not in ("0", "false", "no")
+    v = get("PREFIX_CACHE")
+    if v is not None:
+        kwargs["prefix_cache"] = v.lower() not in ("0", "false", "no")
+    v = get("PREFIX_CACHE_MB")
+    if v is not None:
+        kwargs["prefix_cache_mb"] = float(v)
     return ServiceConfig(**kwargs)

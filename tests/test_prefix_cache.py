@@ -1,0 +1,191 @@
+"""Per-request prefix caching (PREFIX_CACHE, engine/prefix_cache.py):
+LRU mechanics, token identity vs no-cache serving, and composition with
+the continuous-batching loop."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.prefix_cache import PrefixCache
+from mlmicroservicetemplate_tpu.models import gpt as gpt_mod
+from mlmicroservicetemplate_tpu.models.registry import KIND_SEQ2SEQ, ModelBundle
+from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.runtime.device import default_policy
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+
+def test_prefix_cache_lru_mechanics():
+    cache = PrefixCache(buckets=(8, 16, 32), budget_mb=1.0)
+    ids = np.arange(100, 140, dtype=np.int32)
+    # Too short to donate to any bucket at length 8? bucket must be <= L-1.
+    assert cache.bucket_for_insert(8) == 8 or cache.bucket_for_insert(8) is None
+    assert cache.bucket_for_insert(40) == 32
+    assert cache.match(ids, 40) is None  # empty cache
+    kv = {"k": [np.zeros((1, 16, 2, 4), np.float32)]}
+    cache.insert(ids, 16, kv)
+    assert cache.contains(ids, 16)
+    got = cache.match(ids, 40)
+    assert got is not None and got[0] == 16
+    # Different tokens at the same length: no false sharing.
+    other = np.arange(500, 540, dtype=np.int32)
+    assert cache.match(other, 40) is None
+    # Longest match wins.
+    kv32 = {"k": [np.zeros((1, 32, 2, 4), np.float32)]}
+    cache.insert(ids, 32, kv32)
+    assert cache.match(ids, 40)[0] == 32
+    # P <= length-1: a 32-token prompt can only match up to 16.
+    assert cache.match(ids, 32)[0] == 16
+    # Budget eviction: oldest entries fall off.
+    big = {"k": [np.zeros((1, 512, 8, 64), np.float32)]}  # ~1MB
+    cache.insert(np.arange(600, 700, dtype=np.int32), 32, big)
+    cache.insert(np.arange(700, 800, dtype=np.int32), 32, big)
+    assert len(cache) <= 2
+
+
+def _gpt_bundle(seed: int = 0):
+    cfg = gpt_mod.GPTConfig(
+        vocab_size=300, d_model=32, num_heads=2, num_layers=2, d_ff=64,
+        max_position=256, eos_id=257, pad_id=257,
+    )
+    params = gpt_mod.init_params(jax.random.PRNGKey(seed), cfg)
+
+    def encode_fn(p, input_ids, attention_mask):
+        return input_ids
+
+    def init_state_fn(p, input_ids, enc_mask, max_len: int, sample=None):
+        return gpt_mod.init_decode_state(
+            p, cfg, input_ids, enc_mask, max_len, sample=sample
+        )
+
+    def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
+        return gpt_mod.generate_chunk(p, cfg, state, n_steps, sample)
+
+    return ModelBundle(
+        name="gpt2", kind=KIND_SEQ2SEQ, cfg=cfg, params=params,
+        policy=default_policy("cpu"), tokenizer=ByteTokenizer(add_eos=True),
+        labels=None, forward=None, encode_fn=encode_fn,
+        init_state_fn=init_state_fn, generate_chunk_fn=generate_chunk_fn,
+        supports_prefix=True,
+    )
+
+
+def _engine(prefix_cache: bool, **kw):
+    bundle = _gpt_bundle()
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(16, 32, 64), max_decode_len=16, stream_chunk_tokens=4,
+        prefix_cache=prefix_cache, **kw,
+    )
+    return InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1))), bundle, cfg
+
+
+def _feats(tok, ids):
+    return {"input_ids": np.asarray(ids, np.int32),
+            "length": np.int32(len(ids))}
+
+
+def test_request_prefix_cache_token_identity():
+    """Second request sharing a 32-token prefix: (a) hits the cache,
+    (b) streams tokens identical to the cache-off engine."""
+    eng_on, bundle, _ = _engine(True)
+    eng_off, _, _ = _engine(False)
+    assert eng_on.prefix_cache is not None and eng_off.prefix_cache is None
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(5, 250, 40).astype(np.int32)  # covers bucket 32
+    tail_a = rng.integers(5, 250, 6).astype(np.int32)
+    tail_b = rng.integers(5, 250, 9).astype(np.int32)
+
+    for tail in (tail_a, tail_b):
+        ids = np.concatenate([shared, tail])
+        on = np.concatenate(list(eng_on.generate_stream(_feats(None, ids))))
+        off = np.concatenate(list(eng_off.generate_stream(_feats(None, ids))))
+        np.testing.assert_array_equal(on, off)
+    stats = eng_on.prefix_cache.stats()
+    # First request misses and donates; the second hits at P=32.
+    assert stats["hits"] >= 1 and stats["entries"] >= 1
+
+
+def test_request_prefix_cache_composes_with_continuous_loop():
+    """Cache-hit admissions insert narrower states into the shared
+    loop; tokens stay identical to solo serving."""
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+
+    eng, bundle, cfg = _engine(True, max_streams=4)
+    rng = np.random.default_rng(1)
+    shared = rng.integers(5, 250, 20).astype(np.int32)  # covers bucket 16
+    prompts = [
+        np.concatenate([shared, rng.integers(5, 250, n).astype(np.int32)])
+        for n in (4, 7, 11)
+    ]
+    # Seed the cache (first solo request donates the prefix).
+    solo = [
+        np.concatenate(list(eng.generate_stream(_feats(None, p))))
+        for p in prompts
+    ]
+    assert eng.prefix_cache.stats()["entries"] >= 1
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    async def collect(gen):
+        out = []
+        async for c in gen:
+            out.append(np.asarray(c))
+        return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+    async def body():
+        gens = [cdl.submit_stream(_feats(None, p)) for p in prompts]
+        return await asyncio.gather(*[collect(g) for g in gens])
+
+    outs = asyncio.run(body())
+    cdl.stop()
+    hits_after = eng.prefix_cache.stats()["hits"]
+    assert hits_after >= len(prompts)  # loop admissions hit the cache
+    for got, want in zip(outs, solo):
+        n = min(len(got), len(want))
+        np.testing.assert_array_equal(got[:n], want[:n])
+
+
+def test_prefix_cache_rejected_for_unsupported_and_global_combo():
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+
+    with pytest.raises(ValueError, match="PREFIX_CACHE is not supported"):
+        build_model(ServiceConfig(
+            device="cpu", model_name="t5-small", prefix_cache=True
+        ))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        build_model(ServiceConfig(
+            device="cpu", model_name="gpt2", prefix_cache=True,
+            prompt_prefix="sys",
+        ))
+
+
+def test_growing_conversation_keeps_donating():
+    """Turn N of a growing conversation must donate its larger prefix
+    from the HIT path (the hit state holds full contiguous KV) — not
+    stay pinned to turn 1's bucket forever."""
+    eng, bundle, _ = _engine(True)
+    rng = np.random.default_rng(3)
+    base = rng.integers(5, 250, 20).astype(np.int32)   # > bucket 16
+    # Turn 1: miss, donates P=16.
+    for _ in eng.generate_stream(_feats(None, base)):
+        pass
+    assert eng.prefix_cache.contains(base, 16)
+    # Turn 2: longer prompt sharing the base — hits at 16, must donate 32.
+    longer = np.concatenate([base, rng.integers(5, 250, 20).astype(np.int32)])
+    out_on = np.concatenate(list(eng.generate_stream(_feats(None, longer))))
+    assert eng.prefix_cache.contains(longer, 32)
+    # Turn 3 hits at 32 now; tokens identical to cache-off.
+    turn3 = np.concatenate([longer, rng.integers(5, 250, 6).astype(np.int32)])
+    hits_before = eng.prefix_cache.stats()["hits"]
+    out3 = np.concatenate(list(eng.generate_stream(_feats(None, turn3))))
+    m = eng.prefix_cache.match(turn3, len(turn3))
+    assert m is not None and m[0] == 32
+    eng_off, _, _ = _engine(False)
+    off3 = np.concatenate(list(eng_off.generate_stream(_feats(None, turn3))))
+    np.testing.assert_array_equal(out3, off3)

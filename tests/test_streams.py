@@ -142,7 +142,9 @@ def test_concurrent_streams_match_solo_and_share_dispatches():
         # cost 4 streams × 2 follow-up chunks = 8 chunk dispatches; the
         # shared loop pays at most the longest stream's chunks plus one
         # admission-staggering chunk per wave.
-        assert cdl.prefill_dispatches == 4
+        # Wave batching: a multi-stream wave prefills as ONE batched
+        # dispatch (racy wave formation may split it, never exceed N).
+        assert 1 <= cdl.prefill_dispatches <= 4
         assert cdl.chunk_dispatches <= 4, cdl.chunk_dispatches
     finally:
         cdl.stop()
@@ -398,3 +400,45 @@ def test_continuous_batching_on_replica_mesh(cpu_devices):
             np.testing.assert_array_equal(got[:n], want[:n])
     finally:
         cdl.stop()
+
+
+def test_deep_chain_pipelining_token_identity():
+    """chain_depth > 1 (STREAM_PIPELINE): up to D chunk dispatches ride
+    in flight before the oldest is delivered — tokens must remain
+    identical to solo runs, late admission included."""
+    bundle = _echo_bundle()
+    cfg = _cfg(stream_pipeline=3, max_decode_len=16)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    assert cdl.chain_depth == 3 and not cdl._auto_depth
+    texts = ["alpha one", "bb", "stream three!", "dddddd"]
+    feats = [text_feats(bundle.tokenizer, t) for t in texts]
+
+    async def body():
+        gens = [cdl.submit_stream(dict(feats[i])) for i in range(2)]
+        tasks = [asyncio.ensure_future(_collect(g)) for g in gens]
+        await asyncio.sleep(0.3)  # loop runs; chunks in flight
+        gens2 = [cdl.submit_stream(dict(feats[i])) for i in (2, 3)]
+        tasks += [asyncio.ensure_future(_collect(g)) for g in gens2]
+        return await asyncio.gather(*tasks)
+
+    outs = asyncio.run(body())
+    cdl.stop()
+    for f, got in zip(feats, outs):
+        np.testing.assert_array_equal(
+            got, _solo_tokens(eng, f), err_msg=str(f)
+        )
+
+
+def test_auto_depth_tunes_at_warm():
+    """STREAM_PIPELINE=0 (auto): warm() measures RTT vs chunk compute
+    and picks a depth >= 1 (on CPU the ratio is ~0 -> depth stays
+    small); a fixed setting disables tuning."""
+    bundle = tiny_t5_bundle()
+    cfg = _cfg(stream_pipeline=0)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    assert cdl._auto_depth and cdl.chain_depth == 1
+    cdl.warm()
+    assert 1 <= cdl.chain_depth <= 8
+    cdl.stop()
