@@ -107,6 +107,13 @@ async def bench_serving() -> "tuple[dict, object]":
                 sorted(lats)[max(0, math.ceil(len(lats) * 0.99) - 1)] * 1000, 3
             ),
             "req_s": round(N_THROUGHPUT / wall, 3),
+            # Every pass, not just the best: end-to-end req/s on a
+            # relay-attached box swings ~2x with wire weather, and the
+            # spread IS the honest error bar on the headline number.
+            "req_s_passes": [round(N_THROUGHPUT / w, 1) for w in walls],
+            "req_s_median": round(
+                N_THROUGHPUT / statistics.median(walls), 3
+            ),
             "backend": jax.default_backend(),
             "n_devices": engine.replicas.n_devices,
         }, engine

@@ -229,6 +229,54 @@ def mha_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-token-per-head symmetric int8 for K/V cache storage:
+    [..., H, D] → (int8 same shape, f32 scale [..., H, 1]).
+
+    The KV cache is the second HBM-bandwidth term of batched long-
+    context decode (after weights); int8 halves its bytes and the
+    scale factors out of both attention matmuls EXACTLY — see
+    ``mha_attention_kv8`` — so no dense dequantized copy ever
+    materializes (same discipline as ``lm_head_logits``)."""
+    from .quant import symmetric_int8
+
+    return symmetric_int8(x, axis=-1)
+
+
+def mha_attention_kv8(
+    q: jax.Array,  # [B, Sq, H, D]
+    k8: jax.Array,  # [B, Sk, H, D] int8
+    k_scale: jax.Array,  # [B, Sk, H, 1] f32
+    v8: jax.Array,  # [B, Sk, H, D] int8
+    v_scale: jax.Array,  # [B, Sk, H, 1] f32
+    mask: jax.Array | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """mha_attention over an int8-quantized KV cache.
+
+    Scale factoring keeps the HBM reads at int8 width: the key scale
+    multiplies the logit COLUMN it belongs to (logits[...,k] ∝ q·k8[k]
+    · ks[k]), and the value scale folds into the softmax weights
+    before the second matmul (Σ_k w[k]·vs[k]·v8[k] = (w·vs) @ v8) —
+    both matmuls consume the int8 tensors directly (cast in-register),
+    never a dense dequantized cache."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # [B, Sk, H, 1] -> [B, H, 1, Sk] to line up with bhqk logits.
+    ks = jnp.transpose(k_scale[..., 0], (0, 2, 1))[:, :, None, :]
+    vs = jnp.transpose(v_scale[..., 0], (0, 2, 1))[:, :, None, :]
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k8.astype(q.dtype)).astype(jnp.float32)
+        * scale
+        * ks
+    )
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e9))
+    probs = jax.nn.softmax(logits, axis=-1)
+    weighted = (probs * vs).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weighted, v8.astype(q.dtype))
+
+
 def split_heads(x: jax.Array, n_heads: int) -> jax.Array:
     b, s, d = x.shape
     return x.reshape(b, s, n_heads, d // n_heads)

@@ -38,9 +38,11 @@ from .common import (
     dense,
     dense_init,
     embed,
+    kv_quantize,
     lm_head_logits,
     merge_heads,
     mha_attention,
+    mha_attention_kv8,
     normal_init,
     rmsnorm,
     rmsnorm_init,
@@ -64,6 +66,13 @@ class LlamaConfig:
     bos_id: int = 1
     eos_id: int = 2
     pad_id: int = 0
+    # int8 KV cache (QUANT_KV=int8): K/V stored as per-token-per-head
+    # int8 + f32 scales, dequantized by scale factoring inside the
+    # attention matmuls (common.mha_attention_kv8) — halves the KV
+    # HBM term of batched long-context decode.  Generation is NOT
+    # bit-identical to the bf16 cache (quantization is lossy); the
+    # knob ships measured (BASELINE.md) and default-off.
+    kv_quant: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -253,7 +262,27 @@ def init_decode_state(
         collect_kv=True, prefix_kv=prefix_kv,
     )
     cache_k, cache_v = [], []
+    if cfg.kv_quant and p_len:
+        # The registry rejects the combination; defend here too so a
+        # direct caller never silently mixes dense prefix KV into a
+        # quantized cache.
+        raise ValueError("kv_quant does not compose with cached prefixes")
     for li, (k, v) in enumerate(kv):
+        if cfg.kv_quant:
+            # Scales stored in the COMPUTE dtype: the decode step
+            # recovers its working dtype from the state (the int8
+            # payload can't carry it), and mha_attention_kv8 upcasts
+            # scales into the f32 logits anyway.
+            shape = (b, total, cfg.num_kv_heads, cfg.head_dim)
+            k8, ks = kv_quantize(k)
+            v8, vs = kv_quantize(v)
+            ck8 = jnp.zeros(shape, jnp.int8).at[:, :s].set(k8)
+            cks = jnp.ones(shape[:3] + (1,), dtype).at[:, :s].set(ks.astype(dtype))
+            cv8 = jnp.zeros(shape, jnp.int8).at[:, :s].set(v8)
+            cvs = jnp.ones(shape[:3] + (1,), dtype).at[:, :s].set(vs.astype(dtype))
+            cache_k.append((ck8, cks))
+            cache_v.append((cv8, cvs))
+            continue
         ck = jnp.zeros((b, total, cfg.num_kv_heads, cfg.head_dim), k.dtype)
         cv = ck
         if p_len:
@@ -284,8 +313,39 @@ def init_decode_state(
     )
 
 
+def _cache_dtype(state: GPTState):
+    entry = state.cache_k[0]
+    return entry[1].dtype if isinstance(entry, tuple) else entry.dtype
+
+
+def _write_kv(cache, rows_idx, pos_idx, k_new, dtype):
+    """Scatter new K (or V) into a dense or (int8, scale) cache entry."""
+    if isinstance(cache, tuple):
+        q8, sc = kv_quantize(k_new)
+        return (
+            cache[0].at[rows_idx, pos_idx].set(q8, mode="drop"),
+            cache[1].at[rows_idx, pos_idx].set(sc.astype(dtype), mode="drop"),
+        )
+    return cache.at[rows_idx, pos_idx].set(k_new, mode="drop")
+
+
+def _cache_attention(cfg: LlamaConfig, q, ck, cv, mask):
+    """Attention over a dense or int8-quantized KV cache (GQA repeat
+    applies to payloads and scales alike)."""
+    if isinstance(ck, tuple):
+        return mha_attention_kv8(
+            q,
+            _repeat_kv(ck[0], cfg.n_rep), _repeat_kv(ck[1], cfg.n_rep),
+            _repeat_kv(cv[0], cfg.n_rep), _repeat_kv(cv[1], cfg.n_rep),
+            mask=mask,
+        )
+    return mha_attention(
+        q, _repeat_kv(ck, cfg.n_rep), _repeat_kv(cv, cfg.n_rep), mask=mask
+    )
+
+
 def _decode_step(params: Params, cfg: LlamaConfig, state: GPTState, sample: bool = False):
-    dtype = state.cache_k[0].dtype
+    dtype = _cache_dtype(state)
     b = state.last_token.shape[0]
     rows = jnp.arange(b)
     t = state.write_idx  # [B] per-row position
@@ -304,13 +364,11 @@ def _decode_step(params: Params, cfg: LlamaConfig, state: GPTState, sample: bool
         q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
         k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
         v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
-        ck = state.cache_k[li].at[rows, t].set(k1[:, 0], mode="drop")
-        cv = state.cache_v[li].at[rows, t].set(v1[:, 0], mode="drop")
+        ck = _write_kv(state.cache_k[li], rows, t, k1[:, 0], dtype)
+        cv = _write_kv(state.cache_v[li], rows, t, v1[:, 0], dtype)
         new_k.append(ck)
         new_v.append(cv)
-        ctx = mha_attention(
-            q, _repeat_kv(ck, cfg.n_rep), _repeat_kv(cv, cfg.n_rep), mask=attn_mask
-        )
+        ctx = _cache_attention(cfg, q, ck, cv, attn_mask)
         x = x + dense(a["o"], merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
@@ -351,7 +409,7 @@ def multi_step(
     variant of ``gpt.multi_step`` (per-row rotary tables at each
     window position, GQA-width cache writes).  key_valid updates are
     acceptance's job (spec.verify_step)."""
-    dtype = state.cache_k[0].dtype
+    dtype = _cache_dtype(state)
     b, d_w = tokens.shape
     rows = jnp.arange(b)[:, None]  # [B, 1]
     t = state.write_idx  # [B]
@@ -374,13 +432,11 @@ def multi_step(
         q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
         k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
         v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
-        ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
-        cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
+        ck = _write_kv(state.cache_k[li], rows, pos_w, k1, dtype)
+        cv = _write_kv(state.cache_v[li], rows, pos_w, v1, dtype)
         new_k.append(ck)
         new_v.append(cv)
-        ctx = mha_attention(
-            q, _repeat_kv(ck, cfg.n_rep), _repeat_kv(cv, cfg.n_rep), mask=mask
-        )
+        ctx = _cache_attention(cfg, q, ck, cv, mask)
         x = x + dense(a["o"], merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]

@@ -34,17 +34,27 @@ MIN_QUANT_SIZE = 4096  # below this, int8 saves nothing worth the hop
 VALID_MODES = (None, "int8")
 
 
-def _quantize_array(w, per_row: bool):
-    """Symmetric int8: q = round(w / scale), scale = amax/127."""
+def symmetric_int8(x, axis) -> tuple:
+    """THE symmetric-int8 formula (one home for it): q = round(x/s),
+    s = amax/127 reduced over ``axis`` (keepdims), zero-guarded.
+    Shared by the weight path below and the KV-cache path
+    (common.kv_quantize)."""
     import jax.numpy as jnp
 
-    wf = w.astype(jnp.float32)
-    if per_row:  # embeddings [V, D]: scale per row -> gathers stay cheap
-        amax = jnp.max(jnp.abs(wf), axis=tuple(range(1, w.ndim)), keepdims=True)
-    else:  # dense [.., out] / conv HWIO: scale per output channel
-        amax = jnp.max(jnp.abs(wf), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    q8 = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q8, scale
+
+
+def _quantize_array(w, per_row: bool):
+    """Symmetric int8 weights: per-row scales for embeddings (gathers
+    stay cheap), per-output-channel otherwise."""
+    import jax.numpy as jnp
+
+    axis = tuple(range(1, w.ndim)) if per_row else tuple(range(w.ndim - 1))
+    q, scale = symmetric_int8(w, axis)
     return {"q8": q, "scale": scale.astype(jnp.float32)}
 
 

@@ -611,6 +611,8 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     # detokenizer silently truncates at its own eos.
     overrides.setdefault("eos_id", int(tokenizer.eos_id))
     overrides.setdefault("pad_id", int(tokenizer.pad_id))
+    if getattr(svc_cfg, "quant_kv", None) == "int8":
+        overrides["kv_quant"] = True
     cfg = llama_mod.LlamaConfig(**overrides)
 
     max_id = int(getattr(tokenizer, "max_token_id",
@@ -758,6 +760,20 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
             f"SPEC_DECODE is not supported for {svc_cfg.model_name!r} "
             "(speculative decoding covers the decoder families: gpt2, llama)"
         )
+    if getattr(svc_cfg, "quant_kv", None):
+        if bundle.name != "llama":
+            raise ValueError(
+                f"QUANT_KV is not supported for {svc_cfg.model_name!r} "
+                "(int8 KV cache covers the llama family)"
+            )
+        if getattr(svc_cfg, "prefix_cache", False) or getattr(
+            svc_cfg, "prompt_prefix", None
+        ):
+            raise ValueError(
+                "QUANT_KV does not compose with prefix caching: cached "
+                "prefixes carry dense bf16 KV that a quantized cache "
+                "cannot absorb (pick one lever per deployment)"
+            )
     if getattr(svc_cfg, "prefix_cache", False):
         if not bundle.supports_prefix:
             raise ValueError(

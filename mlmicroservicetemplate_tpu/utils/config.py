@@ -107,6 +107,12 @@ class ServiceConfig(BaseModel):
     # "int8" (per-channel symmetric; halves weight bytes per decode
     # step — the lever for HBM-bound small-batch generation).
     quantize: str | None = None
+    # KV-cache quantization (llama family): "int8" stores K/V as
+    # per-token-per-head int8 + scales, halving the SECOND bandwidth
+    # term of batched long-context decode (weights being the first).
+    # Lossy (not bit-identical to bf16-cache generation); measured in
+    # BASELINE.md.  Mutually exclusive with prefix caching.
+    quant_kv: str | None = None
 
     # Speculative decoding for decoder-only families (gpt2/llama):
     # "ngram" drafts the next SPEC_K tokens by prompt-lookup (the last
@@ -157,6 +163,17 @@ class ServiceConfig(BaseModel):
                 return None
             if v != "int8":
                 raise ValueError(f"QUANTIZE must be 'int8' or unset, got {v!r}")
+        return v
+
+    @field_validator("quant_kv")
+    @classmethod
+    def _check_quant_kv(cls, v: str | None) -> str | None:
+        if v is not None:
+            v = v.lower()
+            if v in ("", "none", "0", "false"):
+                return None
+            if v != "int8":
+                raise ValueError(f"QUANT_KV must be 'int8' or unset, got {v!r}")
         return v
 
     @field_validator("spec_decode")
@@ -236,6 +253,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "server_url": "SERVER_URL",
         "log_level": "LOG_LEVEL",
         "quantize": "QUANTIZE",
+        "quant_kv": "QUANT_KV",
         "prompt_prefix": "PROMPT_PREFIX",
         "spec_decode": "SPEC_DECODE",
     }
