@@ -76,6 +76,21 @@ async def main() -> None:
         r6 = await s.stream_stats("the quick brown fox jumps over the lazy dog and")
         rows.append({"config": "gpt2 streaming causal-LM", **r6})
 
+    # The flagship generative config: llama at TinyLlama-1.1B dims,
+    # int8 weights (the measured recommendation at this scale).
+    async with ServiceUnderTest(
+        {
+            "MODEL_NAME": "llama",
+            "QUANTIZE": "int8",
+            "BATCH_BUCKETS": "1,8",
+            "SEQ_BUCKETS": "64",
+            "MAX_DECODE_LEN": "32",
+            **dev,
+        }
+    ) as s:
+        r7 = await s.stream_stats("the quick brown fox jumps over the lazy dog and")
+        rows.append({"config": "llama-1.1B int8 streaming causal-LM", **r7})
+
     import jax
 
     backend = jax.default_backend()

@@ -32,7 +32,19 @@ per-step [B, V] sort.
 A freed slot's row keeps stepping until reused — its writes clamp to
 ``mode="drop"`` in the models and its outputs are discarded, so this
 costs compute but never correctness; ``insert`` overwrites the whole
-row on reuse.
+row on reuse.  The cost is BOUNDED and measured (BASELINE.md round 3):
+a full-width chunk costs chunk(B=n_slots)/chunk(B=live) of a
+right-sized one — 1.4× at llama-bf16 and gpt2 when ONE stream owns
+the loop, and at llama-int8 the batched chunk is outright cheaper per
+token than B=1 (0.86 vs 1.39 ms/step: weight streaming amortizes
+across rows, dead or alive).  Width-bucketed compaction (per-width
+chunk executables + live-row gather + slot remap) was considered and
+deliberately NOT built: on relay-attached hardware the inter-chunk
+cadence is RTT-dominated so the saving is invisible, the worst case
+(B=1 greedy) routes to the speculative per-stream path anyway, and
+operators can right-size statically with MAX_STREAMS (slot count
+follows it).  Revisit if direct-attached profiles show the chunk
+compute on the critical path.
 """
 
 from __future__ import annotations
