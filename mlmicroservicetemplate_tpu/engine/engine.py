@@ -192,6 +192,30 @@ class InferenceEngine:
                     start_prefixed, static_argnums=(5, 6, 7)
                 )
                 self._slice_prefix: dict[int, Any] = {}
+
+                # SPEC_DECODE × PREFIX_CACHE composition: the greedy
+                # B=1 streams the speculative path serves are exactly
+                # the traffic prefix caching targets, so the spec
+                # start has a prefixed variant too — suffix-only
+                # prefill, drafting history seeded with the FULL
+                # prompt (prefix ids are the request's own tokens).
+                if self.spec_enabled:
+                    def spec_start_prefixed(p, pkv, pref_ids, ids, mask,
+                                            sp, max_len: int,
+                                            n_verify: int, spec_k: int):
+                        p2 = dict(p, __prefix__=pkv)
+                        enc = bundle.encode_fn(p2, ids, mask)
+                        state = bundle.init_state_fn(
+                            p2, enc, mask, max_len, sample=sp
+                        )
+                        ss = bundle.init_spec_fn(
+                            state, ids, mask, prefix_ids=pref_ids
+                        )
+                        return bundle.spec_chunk_fn(p2, ss, n_verify, spec_k)
+
+                    self._spec_start_prefixed = jax.jit(
+                        spec_start_prefixed, static_argnums=(6, 7, 8)
+                    )
         else:
             self._forward = jax.jit(bundle.forward)
             self.spec_enabled = False
@@ -326,6 +350,25 @@ class InferenceEngine:
             rows = np.asarray(jax.device_get(logits))
         return [rows[i] for i in range(n)]
 
+    def _prefix_guard(self, length: int):
+        """Static-shape guard for cache hits: the padded suffix bucket
+        must keep positions inside the table AND the combined width
+        inside the continuous loop's max-bucket slots."""
+        s_max = max(self.seq_buckets)
+        max_pos = int(getattr(self.bundle.cfg, "max_position", 1 << 30))
+
+        def usable(p_len: int) -> bool:
+            s_suf = bucket_for(
+                max(length - p_len, 1), self.seq_buckets,
+                self.replicas.seq_multiple(),
+            )
+            return (
+                p_len + s_suf <= s_max
+                and p_len + s_suf + self.max_decode_len <= max_pos
+            )
+
+        return usable
+
     def start_fused(self, feats: dict):
         """Collate + fused prefill-and-first-chunk for ONE stream,
         through the per-request prefix cache when it hits.  Returns
@@ -339,22 +382,7 @@ class InferenceEngine:
         free compute, the prefill already produced it)."""
         row_ids = np.asarray(feats["input_ids"], np.int32)[: int(feats["length"])]
         length = int(feats["length"])
-        s_max = max(self.seq_buckets)
-        max_pos = int(getattr(self.bundle.cfg, "max_position", 1 << 30))
-
-        def usable(p_len: int) -> bool:
-            # Static-shape guards: the padded suffix bucket must keep
-            # positions inside the table AND the combined width inside
-            # the continuous loop's max-bucket slots.
-            s_suf = bucket_for(
-                max(length - p_len, 1), self.seq_buckets,
-                self.replicas.seq_multiple(),
-            )
-            return (
-                p_len + s_suf <= s_max
-                and p_len + s_suf + self.max_decode_len <= max_pos
-            )
-
+        usable = self._prefix_guard(length)
         if self.prefix_cache is not None:
             m = self.prefix_cache.match(row_ids, length, usable=usable)
             if m is not None:
@@ -464,21 +492,67 @@ class InferenceEngine:
         """Speculative streaming (greedy): each dispatch runs
         ``chunk_tokens`` draft→verify rounds, emitting between
         chunk_tokens and chunk_tokens·(spec_k+1) tokens — token
-        sequence identical to the normal greedy path."""
+        sequence identical to the normal greedy path.  Composes with
+        the per-request prefix cache: a hit prefills only the suffix
+        AND seeds the drafting history with the full prompt; a miss
+        donates its prefix like start_fused."""
         import jax
 
         from ..models.spec import flatten_emitted
 
         n_verify = self.chunk_tokens
         budget = self.budget_for(feats)
+        row_ids = np.asarray(feats["input_ids"], np.int32)[: int(feats["length"])]
+        length = int(feats["length"])
         with self._lock:
-            ids, mask, _ = self._collate_text([feats])
-            sp, _ = self._collate_sample([feats], ids.shape[0])
-            ids, mask = self.replicas.place_batch(ids, mask)
-            ss, out, ns = self._spec_start(
-                self.params, ids, mask, sp,
-                self.max_decode_len, n_verify, self.spec_k,
-            )
+            hit = None
+            if self.prefix_cache is not None:
+                hit = self.prefix_cache.match(
+                    row_ids, length, usable=self._prefix_guard(length)
+                )
+            if hit is not None:
+                p_len, pkv = hit
+                sfeats = dict(
+                    feats,
+                    input_ids=row_ids[p_len:],
+                    length=np.int32(length - p_len),
+                )
+                ids, mask, _ = self._collate_text([sfeats])
+                sp, _ = self._collate_sample([feats], ids.shape[0])
+                ids, mask = self.replicas.place_batch(ids, mask)
+                ss, out, ns = self._spec_start_prefixed(
+                    self.params, pkv, row_ids[:p_len], ids, mask,
+                    sp, self.max_decode_len, n_verify, self.spec_k,
+                )
+                # Growing conversations keep donating from the hit
+                # path (same rule as start_fused): capture the largest
+                # bucket this prompt now covers.
+                p_ins = self.prefix_cache.bucket_for_insert(length)
+                if (
+                    p_ins is not None
+                    and p_ins > p_len
+                    and not self.prefix_cache.contains(row_ids, p_ins)
+                ):
+                    self.prefix_cache.insert(
+                        row_ids, p_ins, self._capture_prefix(ss.base, p_ins)
+                    )
+            else:
+                ids, mask, _ = self._collate_text([feats])
+                sp, _ = self._collate_sample([feats], ids.shape[0])
+                ids, mask = self.replicas.place_batch(ids, mask)
+                ss, out, ns = self._spec_start(
+                    self.params, ids, mask, sp,
+                    self.max_decode_len, n_verify, self.spec_k,
+                )
+                if self.prefix_cache is not None:
+                    p_ins = self.prefix_cache.bucket_for_insert(length)
+                    if p_ins is not None and not self.prefix_cache.contains(
+                        row_ids, p_ins
+                    ):
+                        self.prefix_cache.insert(
+                            row_ids, p_ins,
+                            self._capture_prefix(ss.base, p_ins),
+                        )
             out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
         chunk = flatten_emitted(out_np, ns_np, 0)
         metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
@@ -625,6 +699,21 @@ class InferenceEngine:
                                 for p_ins in self.seq_buckets:
                                     if p_len < p_ins <= p_len + s_suf - 1:
                                         self._capture_prefix(st2, p_ins)
+                                # Spec × prefix composition: the
+                                # prefixed spec start + its follow-up
+                                # spec chunk per (prefix, suffix) pair.
+                                if self.spec_enabled:
+                                    ss3, out3, _ = self._spec_start_prefixed(
+                                        self.params, pkv,
+                                        np.ones(p_len, np.int32), sids,
+                                        smask, ssp, self.max_decode_len,
+                                        self.chunk_tokens, self.spec_k,
+                                    )
+                                    ss3, out3, _ = self._spec_chunk(
+                                        self.params, ss3,
+                                        self.chunk_tokens, self.spec_k,
+                                    )
+                                    jax.device_get(out3)
                 # Speculative start + follow-up chunk compile per seq
                 # bucket too (history/cache shapes depend on it).
                 if self.spec_enabled:

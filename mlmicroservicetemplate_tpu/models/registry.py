@@ -61,11 +61,13 @@ class ModelBundle:
     # prompt KV); build_model rejects the knob when unsupported.
     supports_prefix: bool = False
     # Speculative decoding (decoder-only families; models/spec.py):
-    # init_spec_fn(gpt_state, ids, mask) -> SpecState builds the
-    # drafting history; spec_chunk_fn(params, spec_state, n_verify,
-    # spec_k) -> (SpecState, out [B,nv,K+1], n_emit [B,nv]) runs
-    # n_verify draft→verify rounds in one dispatch.  None = family
-    # does not support SPEC_DECODE.
+    # init_spec_fn(gpt_state, ids, mask, prefix_ids=None) -> SpecState
+    # builds the drafting history (``prefix_ids`` arrives on
+    # per-request prefix-cache hits — use spec.make_init_spec_fn, the
+    # contract's one implementation); spec_chunk_fn(params, spec_state,
+    # n_verify, spec_k) -> (SpecState, out [B,nv,K+1], n_emit [B,nv])
+    # runs n_verify draft→verify rounds in one dispatch.  None =
+    # family does not support SPEC_DECODE.
     init_spec_fn: Callable | None = None
     spec_chunk_fn: Callable | None = None
 
@@ -543,8 +545,7 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 
     from . import spec as spec_mod
 
-    def init_spec_fn(state, input_ids, attention_mask):
-        return spec_mod.init_history(state, input_ids, attention_mask, p_len)
+    init_spec_fn = spec_mod.make_init_spec_fn(p_len)
 
     def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
         return spec_mod.spec_chunk(
@@ -661,8 +662,7 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 
     from . import spec as spec_mod
 
-    def init_spec_fn(state, input_ids, attention_mask):
-        return spec_mod.init_history(state, input_ids, attention_mask, p_len)
+    init_spec_fn = spec_mod.make_init_spec_fn(p_len)
 
     def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
         return spec_mod.spec_chunk(
@@ -787,18 +787,5 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
                 "the global prefix occupies positions 0..P that "
                 "per-request prefixes need (the cache generalizes the "
                 "global knob — drop PROMPT_PREFIX)"
-            )
-        if getattr(svc_cfg, "spec_decode", None):
-            # Not an error — the two compose across the traffic mix
-            # (sampled + loop-admitted streams still hit the cache) —
-            # but the B=1 greedy requests SPEC_DECODE routes to the
-            # speculative path bypass the cache entirely, and that is
-            # exactly the traffic both knobs target.  Loud, not silent.
-            log.warning(
-                "SPEC_DECODE + PREFIX_CACHE: greedy streams below "
-                "SPEC_MAX_STREAMS take the speculative path, which does "
-                "not use the per-request prefix cache — their TTFT "
-                "pays full prefill; sampled and concurrent streams "
-                "still get cache hits"
             )
     return bundle

@@ -55,18 +55,43 @@ class SpecState(NamedTuple):
     history: jax.Array
 
 
-def init_history(state, input_ids, attention_mask, p_len: int) -> SpecState:
+def init_history(
+    state, input_ids, attention_mask, p_len: int, prefix_ids=None
+) -> SpecState:
     """Build the drafting history from the (right-padded) prompt.
 
-    ``p_len`` is the cached-prefix length (prefix ids are unknown at
-    this layer — that region stays -1, which simply means no n-gram
-    matches land there)."""
+    ``p_len`` is the cached-prefix length.  When the caller KNOWS the
+    prefix token ids (per-request prefix caching: the prefix is the
+    request's own leading tokens), pass them as ``prefix_ids`` [1, P]
+    so the n-gram lookup drafts from the full prompt; a startup-global
+    PROMPT_PREFIX's ids are unknown at this layer and that region
+    stays -1 (no matches land there)."""
     b, s = input_ids.shape
     total = state.key_valid.shape[1]
     hist = jnp.full((b, total), -1, jnp.int32)
     ids = jnp.where(attention_mask != 0, input_ids, -1).astype(jnp.int32)
     hist = hist.at[:, p_len : p_len + s].set(ids)
+    if prefix_ids is not None:
+        pref = jnp.broadcast_to(
+            jnp.asarray(prefix_ids, jnp.int32).reshape(1, -1), (b, p_len)
+        )
+        hist = hist.at[:, :p_len].set(pref)
     return SpecState(base=state, history=hist)
+
+
+def make_init_spec_fn(p_len: int = 0):
+    """THE bundle ``init_spec_fn`` implementation (one home for the
+    contract): ``(state, input_ids, attention_mask, prefix_ids=None)
+    -> SpecState``.  ``prefix_ids`` arrives on per-request prefix-cache
+    hits (its length wins over the builder's global ``p_len``); the
+    registry builders and custom families alike should use this
+    instead of hand-rolling the closure."""
+
+    def init_spec_fn(state, input_ids, attention_mask, prefix_ids=None):
+        pl = prefix_ids.shape[-1] if prefix_ids is not None else p_len
+        return init_history(state, input_ids, attention_mask, pl, prefix_ids)
+
+    return init_spec_fn
 
 
 def draft_ngram(

@@ -166,8 +166,7 @@ def _tiny_gpt_bundle(seed: int = 0):
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return gpt_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
-    def init_spec_fn(state, input_ids, attention_mask):
-        return spec_mod.init_history(state, input_ids, attention_mask, 0)
+    init_spec_fn = spec_mod.make_init_spec_fn(0)
 
     def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
         return spec_mod.spec_chunk(
@@ -182,6 +181,7 @@ def _tiny_gpt_bundle(seed: int = 0):
         labels=None, forward=None, encode_fn=encode_fn,
         init_state_fn=init_state_fn, generate_chunk_fn=generate_chunk_fn,
         init_spec_fn=init_spec_fn, spec_chunk_fn=spec_chunk_fn,
+        supports_prefix=True,
     )
 
 
@@ -325,3 +325,49 @@ def test_spec_routing_load_gate():
         await batcher.stop()
 
     asyncio.run(body())
+
+
+def test_spec_composes_with_prefix_cache():
+    """SPEC_DECODE + PREFIX_CACHE: the second greedy stream sharing a
+    cached prefix (a) hits the cache on the speculative path, (b)
+    streams tokens identical to spec-without-cache, and (c) drafts
+    from the FULL prompt (history seeded with the known prefix ids)."""
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = _tiny_gpt_bundle()
+    common = dict(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(16, 32, 64), max_decode_len=16, stream_chunk_tokens=4,
+        spec_decode="ngram", spec_k=4,
+    )
+    eng_both = InferenceEngine(
+        bundle, ServiceConfig(prefix_cache=True, **common),
+        ReplicaSet(make_mesh(1)),
+    )
+    eng_spec = InferenceEngine(
+        bundle, ServiceConfig(**common), ReplicaSet(make_mesh(1))
+    )
+    assert eng_both.prefix_cache is not None and eng_both.spec_enabled
+
+    rng = np.random.default_rng(7)
+    shared = rng.integers(5, 250, 40).astype(np.int32)  # covers bucket 32
+    for tail_n in (5, 9):
+        ids = np.concatenate(
+            [shared, rng.integers(5, 250, tail_n).astype(np.int32)]
+        )
+        feats = {"input_ids": ids, "length": np.int32(len(ids))}
+        both = np.concatenate(list(eng_both.generate_stream(dict(feats))))
+        ref = np.concatenate(list(eng_spec.generate_stream(dict(feats))))
+        np.testing.assert_array_equal(both, ref)
+    stats = eng_both.prefix_cache.stats()
+    assert stats["hits"] >= 1 and stats["entries"] >= 1
+    # Hit-path donation (growing conversation): a longer prompt that
+    # hits at 32 must donate its 64-token prefix for the next turn.
+    longer = np.concatenate([shared, rng.integers(5, 250, 30).astype(np.int32)])
+    feats = {"input_ids": longer, "length": np.int32(len(longer))}
+    both = np.concatenate(list(eng_both.generate_stream(dict(feats))))
+    ref = np.concatenate(list(eng_spec.generate_stream(dict(feats))))
+    np.testing.assert_array_equal(both, ref)
+    assert eng_both.prefix_cache.contains(longer, 64)
