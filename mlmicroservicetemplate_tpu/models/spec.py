@@ -101,28 +101,38 @@ def draft_ngram(
     ngram_n: int,
 ) -> jax.Array:
     """Prompt-lookup draft: [B, spec_k] continuation of the most recent
-    earlier occurrence of the last ``ngram_n`` tokens; -1 rows where no
-    match exists (-1 never equals an argmax, so unmatched drafts are
-    rejected for free)."""
+    earlier occurrence of the trailing n-gram, matched LARGEST n first
+    (``ngram_n`` down to 1): longer patterns give higher-precision
+    continuations, and rows they miss fall back to shorter ones —
+    a fallback match that verification rejects costs nothing in the
+    HBM-bound regime (the verify window runs either way), while a
+    fallback match that holds is pure extra acceptance.  -1 rows where
+    no n matches (-1 never equals an argmax → rejected for free).
+
+    One incremental pass: the depth-d candidate mask refines the
+    depth-(d-1) mask, and each depth's most-recent match position is
+    recorded along the way — every n in one sweep, no recomputation."""
     b, total = history.shape
     posv = jnp.arange(total)[None]  # [1, total]
     t = write_idx[:, None]  # [B, 1]
-    # Candidate match position j: history[j-d] == history[t-d] for all
-    # d < ngram_n, strictly before the current position.
-    cand = (posv < t) & (posv >= ngram_n - 1)
+    cand = posv < t  # strictly before the current position
+    j_by_n = []  # most-recent match position per pattern length 1..N
     for d in range(ngram_n):
         tgt = jnp.take_along_axis(
             history, jnp.clip(t - d, 0, total - 1), axis=1
-        )  # [B, 1]
+        )  # [B, 1] token at position t-d (the pattern's d-th-last)
         if d == 0:
             hd = history
         else:
             hd = jnp.pad(
                 history[:, :-d], ((0, 0), (d, 0)), constant_values=-1
             )
-        cand = cand & (hd == tgt) & (tgt >= 0)
-    # Most recent match wins (closest context beats an older span).
-    j = jnp.where(cand, posv, -1).max(axis=1)  # [B], -1 = no match
+        cand = cand & (hd == tgt) & (tgt >= 0) & (posv >= d)
+        j_by_n.append(jnp.where(cand, posv, -1).max(axis=1).astype(jnp.int32))
+    # Largest n wins; rows it missed fall back toward n=1.
+    j = jnp.full((b,), -1, jnp.int32)
+    for j_n in reversed(j_by_n):
+        j = jnp.where(j >= 0, j, j_n)
     gather = jnp.clip(
         j[:, None] + 1 + jnp.arange(spec_k)[None], 0, total - 1
     )

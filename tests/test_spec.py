@@ -371,3 +371,24 @@ def test_spec_composes_with_prefix_cache():
     ref = np.concatenate(list(eng_spec.generate_stream(dict(feats))))
     np.testing.assert_array_equal(both, ref)
     assert eng_both.prefix_cache.contains(longer, 64)
+
+
+def test_draft_ngram_fallback_to_shorter_n():
+    """Largest-n-first fallback: no bigram match but a unigram match
+    drafts from the unigram continuation; a bigram match wins over a
+    different unigram continuation."""
+    #        0  1  2  3  4  5  6
+    hist = np.array([[4, 8, 9, 3, 6, 2, 4]], np.int32)
+    w = np.array([6], np.int32)
+    # trailing bigram (2,4) never occurred; unigram 4 matched at j=0 →
+    # continuation 8, 9.
+    d = np.asarray(spec_mod.draft_ngram(jnp.asarray(hist), jnp.asarray(w), 2, 2))
+    assert d.tolist() == [[8, 9]]
+    # A real bigram match beats a MORE RECENT unigram match whose
+    # continuation differs (this is what makes precedence observable:
+    # unigram-only would pick j=4 and draft [5, 2]).
+    #         0  1  2  3  4  5  6  7  8
+    hist2 = np.array([[2, 4, 7, 3, 4, 5, 9, 2, 4]], np.int32)
+    w2 = np.array([8], np.int32)
+    d2 = np.asarray(spec_mod.draft_ngram(jnp.asarray(hist2), jnp.asarray(w2), 2, 2))
+    assert d2.tolist() == [[7, 3]]
