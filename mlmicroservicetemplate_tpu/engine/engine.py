@@ -158,6 +158,48 @@ class InferenceEngine:
                     bundle.spec_chunk_fn, static_argnums=(2, 3)
                 )
 
+                # Non-streaming greedy batches take the speculative
+                # path too: ONE dispatch of a done-aware while_loop of
+                # verify rounds — same accepted-token economics as the
+                # streaming path, for /v1 clients that don't stream.
+                def full_spec(p, ids, mask, sp, budgets, max_len: int,
+                              spec_k: int):
+                    from jax import lax
+
+                    enc = bundle.encode_fn(p, ids, mask)
+                    state = bundle.init_state_fn(p, enc, mask, max_len, sample=sp)
+                    state = state._replace(
+                        done=state.done | (mask.sum(axis=-1) == 0)
+                    )
+                    ss = bundle.init_spec_fn(state, ids, mask)
+
+                    def cond(s):
+                        return ~s.base.done.all()
+
+                    def body(s):
+                        import jax.numpy as jnp
+
+                        s2, _, _ = bundle.spec_chunk_fn(p, s, 1, spec_k)
+                        # Budget-capped rows stop once they have
+                        # OVERSHOT the cap (≥1 past it, like _full's
+                        # chunk granularity): the host trims to
+                        # max_tokens, and the extra token is what
+                        # distinguishes finish_reason "length" from a
+                        # model that genuinely stopped at the cap.
+                        # +1 (not +spec_k): every round emits ≥1
+                        # token, so one round past the cap suffices.
+                        caps = jnp.minimum(budgets + 1, max_len)
+                        return s2._replace(
+                            base=s2.base._replace(
+                                done=s2.base.done | (s2.base.pos >= caps)
+                            )
+                        )
+
+                    ss = lax.while_loop(cond, body, ss)
+                    return ss.base.tokens, ss.base.pos.max()
+
+                self._full_spec = jax.jit(full_spec, static_argnums=(5, 6))
+
             # Per-request prefix cache (PREFIX_CACHE=1, decoder
             # families without a global PROMPT_PREFIX): recurring
             # prompt prefixes — per-conversation system prompt +
@@ -329,15 +371,31 @@ class InferenceEngine:
                 ids, mask = self.replicas.place_batch(ids, mask)
                 logits = self._forward(self.params, ids, mask)
             else:  # seq2seq, non-streaming: ONE dispatch for encode +
-                # init + done-aware chunked decode (early EOS exit)
+                # init + done-aware chunked decode (early EOS exit);
+                # all-greedy batches under SPEC_DECODE run verify
+                # rounds instead of single-token steps.
                 ids, mask, n = self._collate_text(feats)
                 sp, sampled = self._collate_sample(feats, ids.shape[0])
                 budgets = self._collate_budget(feats, ids.shape[0])
                 ids, mask = self.replicas.place_batch(ids, mask)
-                tokens, steps = self._full(
-                    self.params, ids, mask, sp, budgets,
-                    self.max_decode_len, self.chunk_tokens, sampled,
+                # Speculation is the LOW-CONCURRENCY lever (same gate
+                # as stream routing): at large batches the
+                # (spec_k+1)-wide verify window stops hiding under
+                # weight streaming and low-acceptance traffic would
+                # regress below the chunked scan.
+                spec_batch = self.spec_enabled and not sampled and n <= int(
+                    getattr(self.cfg, "spec_max_streams", 1)
                 )
+                if spec_batch:
+                    tokens, steps = self._full_spec(
+                        self.params, ids, mask, sp, budgets,
+                        self.max_decode_len, self.spec_k,
+                    )
+                else:
+                    tokens, steps = self._full(
+                        self.params, ids, mask, sp, budgets,
+                        self.max_decode_len, self.chunk_tokens, sampled,
+                    )
                 # tokens + step count in ONE transfer (each device_get
                 # pays a full relay round-trip).
                 rows, steps_np = jax.device_get((tokens, steps))

@@ -392,3 +392,68 @@ def test_draft_ngram_fallback_to_shorter_n():
     w2 = np.array([8], np.int32)
     d2 = np.asarray(spec_mod.draft_ngram(jnp.asarray(hist2), jnp.asarray(w2), 2, 2))
     assert d2.tolist() == [[7, 3]]
+
+
+def test_full_spec_nonstream_token_identity():
+    """Non-streaming greedy run_batch under SPEC_DECODE returns rows
+    identical to the spec-off engine (ragged batch, budgets, EOS);
+    sampled batches keep the normal path (seeded identity)."""
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = _tiny_gpt_bundle()
+    common = dict(
+        device="cpu", warmup=False, batch_buckets=(1, 2, 4),
+        seq_buckets=(32,), max_decode_len=16, stream_chunk_tokens=4,
+    )
+    eng_on = InferenceEngine(
+        bundle,
+        ServiceConfig(spec_decode="ngram", spec_k=4, spec_max_streams=4,
+                      **common),
+        ReplicaSet(make_mesh(1)),
+    )
+    eng_off = InferenceEngine(
+        bundle, ServiceConfig(**common), ReplicaSet(make_mesh(1))
+    )
+    # Routing gate: a batch larger than spec_max_streams keeps _full.
+    eng_gated = InferenceEngine(
+        bundle,
+        ServiceConfig(spec_decode="ngram", spec_k=4, spec_max_streams=1,
+                      **common),
+        ReplicaSet(make_mesh(1)),
+    )
+    feats = []
+    for text, cap in (("abcabcabcabc", None), ("xy", 3), ("hello world", 7)):
+        ids, mask = bundle.tokenizer.encode(text, 32)
+        f = {"input_ids": ids, "length": np.int32(int(mask.sum()))}
+        if cap is not None:
+            f["max_tokens"] = cap
+        feats.append(f)
+    on = eng_on.run_batch([dict(f) for f in feats])
+    off = eng_off.run_batch([dict(f) for f in feats])
+    for f, a, b in zip(feats, on, off):
+        cap = int(f.get("max_tokens", 0)) or None
+        if cap is None:
+            # Uncapped rows: exact identity (EOS or server budget).
+            np.testing.assert_array_equal(a, b)
+        else:
+            # Capped rows: identical through the cap, and BOTH paths
+            # overshoot by >=1 when the model didn't EOS — that extra
+            # token is what makes finish_reason report "length"
+            # (granularity past the cap differs: chunk vs verify
+            # window, so the tails beyond cap+1 may differ in count).
+            np.testing.assert_array_equal(a[:cap], b[:cap])
+            pad = 257
+            assert (a[:cap + 1] != pad).all(), "spec path must overshoot cap"
+            assert (b[:cap + 1] != pad).all(), "chunked path must overshoot cap"
+
+    # Over-gate batch: routes to _full, exact identity by construction.
+    g = eng_gated.run_batch([dict(f) for f in feats])
+    for a, b in zip(g, off):
+        np.testing.assert_array_equal(a, b)
+
+    sampled = [dict(feats[0], temperature=1.0, seed=3)]
+    s_on = eng_on.run_batch([dict(sampled[0])])
+    s_off = eng_off.run_batch([dict(sampled[0])])
+    np.testing.assert_array_equal(s_on[0], s_off[0])
