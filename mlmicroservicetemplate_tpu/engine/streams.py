@@ -159,6 +159,12 @@ class ContinuousDecodeLoop:
         # Admissions dispatched but not yet fetched/inserted; the loop's
         # failure handler must terminate these consumers too.
         self._pending_admissions: list = []
+        # Streams popped off `pending` whose prefill has NOT yet been
+        # dispatched this iteration: the failure handler must be able
+        # to terminate them — a chunk-dispatch exception between the
+        # pop and the dispatch would otherwise orphan their consumers
+        # (blocked forever) and leak max_streams slots.
+        self._pending_wave: list = []
         # Observability + test hooks: how many device dispatches this
         # loop has issued (the whole point is that chunk_dispatches
         # scales with the LONGEST stream, not the stream count).
@@ -309,6 +315,7 @@ class ContinuousDecodeLoop:
                 # in flight, dispatching more only wastes device/relay
                 # bandwidth and delays completion detection.
                 dispatched = False
+                self._pending_wave = wave
                 if self.active and self._work_remains():
                     self._dispatch_chunk()
                     dispatched = True
@@ -323,6 +330,7 @@ class ContinuousDecodeLoop:
                     # the chunk dispatch (async host copies started at
                     # dispatch).
                     self._pending_admissions = self._admit_dispatch(wave)
+                self._pending_wave = []
                 if self._pending_admissions:
                     self._admit_complete(self._pending_admissions)
                     self._pending_admissions = []
@@ -340,6 +348,9 @@ class ContinuousDecodeLoop:
                 for st, *_ in self._pending_admissions:
                     self._finish(st, e)
                 self._pending_admissions = []
+                for st in self._pending_wave:
+                    self._finish(st, e)
+                self._pending_wave = []
                 for slot in list(self.active):
                     st = self.active.get(slot)
                     if st is not None:

@@ -442,3 +442,50 @@ def test_auto_depth_tunes_at_warm():
     cdl.warm()
     assert 1 <= cdl.chain_depth <= 8
     cdl.stop()
+
+
+def test_chunk_dispatch_failure_does_not_orphan_wave():
+    """A chunk-dispatch exception raised in the SAME iteration that
+    popped a wave off the pending queue must terminate the wave's
+    consumers with the error (not leave them blocked forever) and
+    return their admission slots."""
+    bundle = _echo_bundle()
+    cfg = _cfg(max_streams=4, max_decode_len=96, stream_chunk_tokens=2)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    f_long = text_feats(bundle.tokenizer, "a prompt spanning many chunks")
+    f_b = text_feats(bundle.tokenizer, "bb")
+
+    # Raise ONLY when this iteration popped a wave: the exact
+    # interleaving the orphan bug needed.
+    orig_dc = ContinuousDecodeLoop._dispatch_chunk
+
+    def dc(self):
+        if self._pending_wave:
+            raise RuntimeError("injected dispatch failure")
+        return orig_dc(self)
+
+    cdl._dispatch_chunk = dc.__get__(cdl)
+
+    async def body():
+        gen_a = cdl.submit_stream(dict(f_long))
+        # First chunk delivered == A is admitted and definitely
+        # mid-flight (budget 96 >> chunk 2) — no sleeps, no races.
+        await asyncio.wait_for(gen_a.__anext__(), timeout=60)
+        gen_b = cdl.submit_stream(dict(f_b))
+        with pytest.raises(RuntimeError, match="injected"):
+            await asyncio.wait_for(_collect(gen_b), timeout=30)
+        # A also saw the failure (it was active when the chunk raised).
+        with pytest.raises(Exception):
+            await asyncio.wait_for(_collect(gen_a), timeout=30)
+        # Slots returned; the loop recovers for fresh streams.
+        for _ in range(200):
+            if cdl._admitted == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert cdl._admitted == 0
+        out = await _collect(cdl.submit_stream(dict(f_b)))
+        assert out.size > 0
+
+    asyncio.run(body())
+    cdl.stop()
