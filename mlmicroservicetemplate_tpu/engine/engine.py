@@ -622,11 +622,30 @@ class InferenceEngine:
         produced = int(chunk.size)
         yield chunk
         done = bool(done_np[0])
+        # Depth-1 chain pipelining (the continuous loop's trick): the
+        # spec state chain is pure device-side, so chunk k+1 dispatches
+        # BEFORE chunk k's tokens are fetched — the ~RTT-long fetch
+        # overlaps the next chunk's compute.  At most one dispatched
+        # chunk is wasted at the tail (EOS/budget), and the optimistic
+        # dispatch is skipped once the budget could already be covered.
+        ahead = None
         while not done and produced < budget:
             with self._lock:
-                ss, out, ns = self._spec_chunk(
-                    self.params, ss, n_verify, self.spec_k
-                )
+                if ahead is None:
+                    ahead = self._spec_chunk(
+                        self.params, ss, n_verify, self.spec_k
+                    )
+                ss, out, ns = ahead
+                ahead = None
+                if produced + n_verify < budget:  # ≥1 token per round
+                    ahead = self._spec_chunk(
+                        self.params, ss, n_verify, self.spec_k
+                    )
+                for arr in (out, ns, ss.base.done):
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:
+                        pass
                 out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
             chunk = flatten_emitted(out_np, ns_np, 0)
             metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
