@@ -65,6 +65,18 @@ log = logging.getLogger(__name__)
 _END = object()
 
 
+
+def prefetch_to_host(*arrays) -> None:
+    """Best-effort async device→host copy start: the later blocking
+    fetch finds the data (mostly) on this side of the wire.  Backends
+    without async copies just pay the round-trip at fetch time."""
+    for arr in arrays:
+        try:
+            arr.copy_to_host_async()
+        except Exception:
+            pass
+
+
 class StreamClosedError(Exception):
     """The decode loop is shutting down."""
 
@@ -419,11 +431,7 @@ class ContinuousDecodeLoop:
                         self._finish(st, e)
                         continue
                     self.prefill_dispatches += 1
-                    for arr in (toks, state1.done):
-                        try:
-                            arr.copy_to_host_async()
-                        except Exception:
-                            pass  # backend without async copies
+                    prefetch_to_host(toks, state1.done)
                     started.append((st, state1, toks, sampled, 0))
                 return started
             try:
@@ -446,11 +454,7 @@ class ContinuousDecodeLoop:
                     self._finish(st, e)
                 return started
             self.prefill_dispatches += 1
-            for arr in (toks, state1.done):
-                try:
-                    arr.copy_to_host_async()
-                except Exception:
-                    pass
+            prefetch_to_host(toks, state1.done)
             for row, st in enumerate(ok):
                 # Slot sampling is PER ROW, not the wave-level flag the
                 # batched executable ran with: one sampled request in a
@@ -597,11 +601,7 @@ class ContinuousDecodeLoop:
         done = self._state.done
         # Start the host copies now so the fetch in _deliver_oldest
         # finds the data (mostly) already on this side of the wire.
-        for arr in (toks, done):
-            try:
-                arr.copy_to_host_async()
-            except Exception:
-                pass  # backend without async copies: fetch pays the RTT
+        prefetch_to_host(toks, done)
         self.chunk_dispatches += 1
         metrics.STREAM_BATCH.labels(eng.bundle.name).observe(len(self.active))
         self._inflight_chunks.append((toks, done, dict(self.active)))
