@@ -60,11 +60,14 @@ class ModelBundle:
     # Whether this family consumed cfg.prompt_prefix (cached system-
     # prompt KV); build_model rejects the knob when unsupported.
     supports_prefix: bool = False
-    # Speculative decoding (decoder-only families; models/spec.py):
-    # init_spec_fn(gpt_state, ids, mask, prefix_ids=None) -> SpecState
+    # Speculative decoding (generative families; models/spec.py):
+    # init_spec_fn(state, ids, mask, prefix_ids=None) -> SpecState
     # builds the drafting history (``prefix_ids`` arrives on
-    # per-request prefix-cache hits — use spec.make_init_spec_fn, the
-    # contract's one implementation); spec_chunk_fn(params, spec_state,
+    # per-request prefix-cache hits).  Decoder-only families use
+    # spec.make_init_spec_fn (the contract's one implementation for
+    # the GPTState layout); encoder-decoders need their own history
+    # layout — t5.init_spec_state prepends the ENCODER ids so lookup
+    # drafts from the document.  spec_chunk_fn(params, spec_state,
     # n_verify, spec_k) -> (SpecState, out [B,nv,K+1], n_emit [B,nv])
     # runs n_verify draft→verify rounds in one dispatch.  None =
     # family does not support SPEC_DECODE.
@@ -462,6 +465,21 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return t5_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
+    # Speculative decoding: summarization quotes its input, so the
+    # drafting history is [encoder ids | decoder tokens] and prompt-
+    # lookup matches land in the document itself (t5.init_spec_state).
+    from . import spec as spec_mod
+
+    def init_spec_fn(state, input_ids, attention_mask, prefix_ids=None):
+        return t5_mod.init_spec_state(state, input_ids, attention_mask)
+
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+        return spec_mod.spec_chunk(
+            p, spec_state, n_verify, spec_k, int(svc_cfg.spec_ngram),
+            lambda pp, st, toks: t5_mod.multi_step(pp, cfg, st, toks),
+            cfg.eos_id, cfg.pad_id,
+        )
+
     return ModelBundle(
         name="t5-small",
         kind=KIND_SEQ2SEQ,
@@ -474,6 +492,8 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         encode_fn=encode_fn,
         init_state_fn=init_state_fn,
         generate_chunk_fn=generate_chunk_fn,
+        init_spec_fn=init_spec_fn,
+        spec_chunk_fn=spec_chunk_fn,
     )
 
 
@@ -758,7 +778,8 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
     if getattr(svc_cfg, "spec_decode", None) and bundle.spec_chunk_fn is None:
         raise ValueError(
             f"SPEC_DECODE is not supported for {svc_cfg.model_name!r} "
-            "(speculative decoding covers the decoder families: gpt2, llama)"
+            "(speculative decoding covers the generative families: "
+            "gpt2, llama, t5-small)"
         )
     if getattr(svc_cfg, "quant_kv", None):
         if bundle.name != "llama":

@@ -30,7 +30,13 @@ All control flow is static-shape: each verify step processes a fixed
 ``spec_k + 1`` token window and returns a fixed-width output row plus a
 per-row valid count; the host slices counts off the fetched buffer.
 Works on any decoder family exposing a ``multi_step`` window forward
-(gpt.py, llama.py — the GPTState contract).
+(gpt.py, llama.py — the GPTState contract) AND on encoder-decoders
+(t5.py): the history buffer may be WIDER than the KV cache by a
+constant prefix that holds the encoder input ids — cache position p
+maps to history position p + (hist_width - cache_width).  For T5 that
+prefix is the document being summarized, exactly where summaries quote
+from, so prompt-lookup drafts land at their highest-acceptance
+workload.  Decoder-only families have equal widths and a zero offset.
 """
 
 from __future__ import annotations
@@ -80,12 +86,14 @@ def init_history(
 
 
 def make_init_spec_fn(p_len: int = 0):
-    """THE bundle ``init_spec_fn`` implementation (one home for the
-    contract): ``(state, input_ids, attention_mask, prefix_ids=None)
-    -> SpecState``.  ``prefix_ids`` arrives on per-request prefix-cache
-    hits (its length wins over the builder's global ``p_len``); the
-    registry builders and custom families alike should use this
-    instead of hand-rolling the closure."""
+    """THE ``init_spec_fn`` implementation for DECODER-ONLY families
+    (the GPTState layout): ``(state, input_ids, attention_mask,
+    prefix_ids=None) -> SpecState``.  ``prefix_ids`` arrives on
+    per-request prefix-cache hits (its length wins over the builder's
+    global ``p_len``); decoder-only builders and custom families should
+    use this instead of hand-rolling the closure.  Encoder-decoders
+    have a different history layout (the encoder ids prepend the
+    buffer) — see ``t5.init_spec_state``."""
 
     def init_spec_fn(state, input_ids, attention_mask, prefix_ids=None):
         pl = prefix_ids.shape[-1] if prefix_ids is not None else p_len
@@ -175,7 +183,13 @@ def verify_step(
     rows = jnp.arange(b)[:, None]  # [B, 1]
     offs = jnp.arange(width)[None]  # [1, width]
 
-    draft = draft_ngram(hist, st.write_idx, spec_k, ngram_n)
+    # Cache→history index offset: encoder-decoder families prepend the
+    # encoder input ids to the history buffer (t5.init_spec_state), so
+    # cache position p lives at history position p + hoff.  Both widths
+    # are static, so this is a trace-time constant (0 for decoder-only).
+    hoff = hist.shape[1] - st.key_valid.shape[1]
+
+    draft = draft_ngram(hist, st.write_idx + hoff, spec_k, ngram_n)
     tokens = jnp.concatenate([st.last_token[:, None], draft], axis=1)
     # Draft slots may hold -1 (no match): embedding lookups need a real
     # id — feed pad instead; acceptance still compares the RAW draft,
@@ -207,9 +221,11 @@ def verify_step(
         posv < (st.write_idx + n_emit)[:, None]
     )
     key_valid = jnp.where(newly_valid, 1, st.key_valid)
-    # Token g_i will be embedded at position t+1+i (history invariant).
+    # Token g_i will be embedded at cache position t+1+i — history
+    # position hoff+t+1+i (history invariant); sentinel = hist width.
     hist = hist.at[
-        rows, jnp.where(emit, st.write_idx[:, None] + 1 + offs, total)
+        rows,
+        jnp.where(emit, st.write_idx[:, None] + hoff + 1 + offs, hist.shape[1]),
     ].set(out, mode="drop")
     last = jnp.where(
         n_emit > 0,

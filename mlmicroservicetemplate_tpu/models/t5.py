@@ -270,6 +270,21 @@ def init_decode_state(
     )
 
 
+def _lm_logits(params: Params, cfg: T5Config, x: jax.Array) -> jax.Array:
+    """Tied/untied lm_head with T5's d_model**-0.5 output scale; f32
+    logits.  Quantized heads use the scale-factored matmul (no full-
+    precision copy of the table inside the decode scan —
+    common.lm_head_logits).  One home for the head dispatch: greedy and
+    speculative paths MUST share it or their argmaxes can diverge."""
+    from .common import lm_head_logits
+
+    x = x * (cfg.d_model**-0.5)
+    lm = params.get("lm_head", params["shared"])
+    if "kernel" in lm:
+        return lm_head_logits(x, lm["kernel"], transposed=False)
+    return lm_head_logits(x, lm["embedding"], transposed=True)
+
+
 def _decode_step(
     params: Params, cfg: T5Config, state: DecodeState, sample: bool = False
 ) -> tuple[DecodeState, jax.Array]:
@@ -315,17 +330,7 @@ def _decode_step(
         x = x + h
 
     x = rmsnorm(params["decoder"]["final_ln"], x)
-    # Tied lm_head with T5's d_model**-0.5 output scale; logits in f32.
-    # Quantized heads use the scale-factored matmul (no full-precision
-    # copy of the table inside the decode scan — common.lm_head_logits).
-    x = x * (cfg.d_model**-0.5)
-    from .common import lm_head_logits
-
-    lm = params.get("lm_head", params["shared"])
-    if "kernel" in lm:
-        logits = lm_head_logits(x[:, 0], lm["kernel"], transposed=False)
-    else:
-        logits = lm_head_logits(x[:, 0], lm["embedding"], transposed=True)
+    logits = _lm_logits(params, cfg, x[:, 0])
 
     if sample:
         from .sampling import select_token
@@ -383,3 +388,131 @@ def greedy_generate(
     state = init_decode_state(params, cfg, enc, attention_mask, max_len)
     state, _ = generate_chunk(params, cfg, state, max_len)
     return state.tokens
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (models/spec.py contract)
+
+
+class SpecDecodeState(NamedTuple):
+    """DecodeState recast to the spec contract (models/spec.py): the
+    generic ``verify_step`` drives any base exposing cache_k/cache_v/
+    key_valid/write_idx/pos/last_token/done/tokens via ``_replace`` —
+    the T5-only fields (cross-KV, encoder mask) ride along untouched.
+
+    T5's decoder positions are contiguous from 0 (no prompt prefill in
+    the decoder), so ``write_idx == pos`` always, and ``key_valid`` is
+    equivalent to ``position < write_idx`` — materialized as a buffer
+    because acceptance-driven validity is the spec contract's currency.
+    """
+
+    cache_k: Any  # list of [B, Tmax, H, D] self-attn caches
+    cache_v: Any
+    cross_k: Any
+    cross_v: Any
+    enc_mask: jax.Array
+    key_valid: jax.Array  # [B, Tmax] int32
+    write_idx: jax.Array  # [B] int32 (== pos)
+    pos: jax.Array  # [B] int32
+    last_token: jax.Array  # [B] int32
+    done: jax.Array  # [B] bool
+    tokens: jax.Array  # [B, Tmax] int32
+    sample: Any
+
+
+def init_spec_state(state: DecodeState, input_ids, attention_mask):
+    """Fresh DecodeState → spec.SpecState whose history buffer holds
+    [encoder input ids | decoder tokens]: the history is WIDER than the
+    decoder cache by S_enc, which the generic verify_step reads off the
+    shapes as the cache→history offset.  Drafting therefore matches
+    n-grams against the DOCUMENT — summaries quote their input, which
+    is where prompt-lookup acceptance comes from on seq2seq traffic.
+
+    Invariant (spec.py): history[b, hoff + write_idx[b]] == the token
+    embedded at cache position write_idx — at init, decoder_start at
+    history position S_enc."""
+    from .spec import SpecState
+
+    b, s_enc = input_ids.shape
+    t_max = state.tokens.shape[1]
+    base = SpecDecodeState(
+        cache_k=state.cache_k,
+        cache_v=state.cache_v,
+        cross_k=state.cross_k,
+        cross_v=state.cross_v,
+        enc_mask=state.enc_mask,
+        key_valid=(
+            jnp.arange(t_max)[None] < state.pos[:, None]
+        ).astype(jnp.int32),
+        write_idx=state.pos,
+        pos=state.pos,
+        last_token=state.last_token,
+        done=state.done,
+        tokens=state.tokens,
+        sample=state.sample,
+    )
+    hist = jnp.full((b, s_enc + t_max), -1, jnp.int32)
+    ids = jnp.where(attention_mask != 0, input_ids, -1).astype(jnp.int32)
+    hist = hist.at[:, :s_enc].set(ids)
+    hist = hist.at[jnp.arange(b), s_enc + state.pos].set(state.last_token)
+    return SpecState(base=base, history=hist)
+
+
+def multi_step(
+    params: Params, cfg: T5Config, state: SpecDecodeState, tokens: jax.Array
+) -> tuple[list, list, jax.Array]:
+    """Window forward for speculative verification: D decoder tokens per
+    row at positions write_idx..write_idx+D-1 in ONE pass (self-attn
+    over the valid cache + causal in-window prefix, cross-attn to the
+    cached encoder).  Returns (new_k, new_v, logits [B, D, V]);
+    key_valid is NOT updated — acceptance decides validity
+    (spec.verify_step), so rejected-position K/V stays invisible."""
+    dtype = state.cross_k[0].dtype
+    b, d_w = tokens.shape
+    rows = jnp.arange(b)[:, None]  # [B, 1]
+    t = state.write_idx  # [B]
+    pos_w = t[:, None] + jnp.arange(d_w)[None]  # [B, D]
+    max_len = state.tokens.shape[1]
+    x = embed(params["shared"], tokens, dtype)  # [B, D, Dm]
+    k_pos = jnp.arange(max_len, dtype=jnp.int32)
+    base_valid = (state.key_valid != 0)[:, None, :]  # [B, 1, T]
+    in_window = (k_pos[None, None, :] >= t[:, None, None]) & (
+        k_pos[None, None, :] <= pos_w[:, :, None]
+    )  # [B, D, T]
+    mask = (base_valid | in_window)[:, None]  # [B, 1, D, T]
+    rel = params["decoder"]["layers"][0]["self_attn"]["rel_bias"]
+    buckets = _relative_bucket(
+        k_pos[None, None, :] - pos_w[:, :, None],  # [B, D, T]
+        False, cfg.rel_buckets, cfg.rel_max_distance,
+    )
+    bias = jnp.transpose(embed(rel, buckets), (0, 3, 1, 2))  # [B, H, D, T]
+    cross_mask = state.enc_mask[:, None, None, :].astype(bool)
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["decoder"]["layers"]):
+        sa = layer["self_attn"]
+        h = rmsnorm(layer["self_attn_ln"], x)
+        q = split_heads(dense(sa["q"], h), cfg.num_heads)  # [B, D, H, Dh]
+        k1 = split_heads(dense(sa["k"], h), cfg.num_heads)
+        v1 = split_heads(dense(sa["v"], h), cfg.num_heads)
+        ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
+        cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(q, ck, cv, mask=mask, bias=bias, scale=1.0)
+        x = x + dense(sa["out"], merge_heads(ctx))
+
+        ca = layer["cross_attn"]
+        h = rmsnorm(layer["cross_attn_ln"], x)
+        qc = split_heads(dense(ca["q"], h), cfg.num_heads)
+        ctx = mha_attention(
+            qc, state.cross_k[li], state.cross_v[li], mask=cross_mask, scale=1.0
+        )
+        x = x + dense(ca["out"], merge_heads(ctx))
+
+        h = rmsnorm(layer["mlp_ln"], x)
+        h = dense(layer["mlp"]["wo"], jax.nn.relu(dense(layer["mlp"]["wi"], h)))
+        x = x + h
+
+    x = rmsnorm(params["decoder"]["final_ln"], x)
+    return new_k, new_v, _lm_logits(params, cfg, x)  # [B, D, V]

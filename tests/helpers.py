@@ -116,11 +116,24 @@ def tiny_t5_bundle(seed: int = 0) -> ModelBundle:
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return t5_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
+    from mlmicroservicetemplate_tpu.models import spec as spec_mod
+
+    def init_spec_fn(state, input_ids, attention_mask, prefix_ids=None):
+        return t5_mod.init_spec_state(state, input_ids, attention_mask)
+
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+        return spec_mod.spec_chunk(
+            p, spec_state, n_verify, spec_k, 2,
+            lambda pp, st, toks: t5_mod.multi_step(pp, cfg, st, toks),
+            cfg.eos_id, cfg.pad_id,
+        )
+
     return ModelBundle(
         name="t5-small", kind=KIND_SEQ2SEQ, cfg=cfg, params=params, policy=policy,
         tokenizer=build_tokenizer(None, for_t5=True), labels=None, forward=None,
         encode_fn=encode_fn, init_state_fn=init_state_fn,
         generate_chunk_fn=generate_chunk_fn,
+        init_spec_fn=init_spec_fn, spec_chunk_fn=spec_chunk_fn,
     )
 
 

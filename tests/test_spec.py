@@ -122,6 +122,129 @@ def test_spec_token_identity_llama():
     _identity_case(llama_mod, cfg, 1, [6, 11], 24)
 
 
+# ---------------------------------------------------------------------------
+# T5 (encoder-decoder): history = [encoder ids | decoder tokens]
+
+
+def _t5_spec_generate(params, cfg, ids, mask, max_len, spec_k=4, ngram=2,
+                      n_verify=2):
+    """T5 variant of _spec_generate: encode once, then drive spec_chunk
+    rounds to exhaustion (the engine's spec path in miniature)."""
+    from mlmicroservicetemplate_tpu.models import t5 as t5_mod
+
+    multi = lambda p, st, toks: t5_mod.multi_step(p, cfg, st, toks)
+    enc = t5_mod.encode(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    state = t5_mod.init_decode_state(
+        params, cfg, enc, jnp.asarray(mask), max_len
+    )
+    ss = t5_mod.init_spec_state(state, jnp.asarray(ids), jnp.asarray(mask))
+    chunk = jax.jit(
+        lambda p, s: spec_mod.spec_chunk(
+            p, s, n_verify, spec_k, ngram, multi, cfg.eos_id, cfg.pad_id
+        )
+    )
+    emitted = [[] for _ in range(ids.shape[0])]
+    rounds = 0
+    while True:
+        ss, out, ns = chunk(params, ss)
+        out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
+        rounds += n_verify
+        for b in range(ids.shape[0]):
+            emitted[b].extend(
+                int(t) for t in spec_mod.flatten_emitted(out_np, ns_np, b)
+            )
+        if bool(done_np.all()) or min(len(e) for e in emitted) >= max_len:
+            break
+        assert rounds < max_len * 4, "t5 spec loop failed to converge"
+    return emitted, rounds
+
+
+def _t5_identity_case(cfg, params, seed, prompts_lens, max_len):
+    from mlmicroservicetemplate_tpu.models import t5 as t5_mod
+
+    b = len(prompts_lens)
+    s = max(prompts_lens)
+    rng = np.random.default_rng(seed)
+    ids = np.zeros((b, s), np.int32)
+    mask = np.zeros((b, s), np.int32)
+    for i, L in enumerate(prompts_lens):
+        cycle = rng.integers(3, cfg.vocab_size, rng.integers(2, 5))
+        ids[i, :L] = np.tile(cycle, (L // len(cycle)) + 1)[:L]
+        mask[i, :L] = 1
+    ref = np.asarray(
+        t5_mod.greedy_generate(
+            params, cfg, jnp.asarray(ids), jnp.asarray(mask), max_len
+        )
+    )
+    emitted, rounds = _t5_spec_generate(params, cfg, ids, mask, max_len)
+    for i in range(b):
+        got = emitted[i][:max_len]
+        want = ref[i].tolist()
+        assert got == want[: len(got)], f"row {i}: {got} != {want}"
+        if len(got) < max_len:
+            assert got and got[-1] == cfg.eos_id, (
+                f"row {i} stopped early without EOS"
+            )
+            assert all(t == cfg.pad_id for t in want[len(got):])
+    return emitted, rounds
+
+
+def test_spec_token_identity_t5():
+    """T5 spec emission equals non-speculative greedy exactly (ragged
+    encoder batches included) — config #5's family gets the lever."""
+    from mlmicroservicetemplate_tpu.models import t5 as t5_mod
+
+    cfg = t5_mod.T5Config(
+        vocab_size=23, d_model=32, d_kv=8, num_heads=2, d_ff=64,
+        num_layers=2,
+    )
+    for seed, lens in ((0, [7, 12]), (3, [5])):
+        params = t5_mod.init_params(jax.random.PRNGKey(seed), cfg)
+        # Untied head (helpers.py rationale: tied + random init argmax-
+        # locks onto the start token, making generation all-pad).
+        params["lm_head"] = {
+            "kernel": jax.random.normal(
+                jax.random.PRNGKey(seed + 99),
+                (cfg.d_model, cfg.vocab_size), jnp.float32,
+            )
+        }
+        _t5_identity_case(cfg, params, seed, lens, 24)
+
+
+def test_t5_spec_drafts_from_encoder_input():
+    """The history buffer holds the encoder ids before the decoder
+    region: a generated token matching an encoder n-gram must draft the
+    encoder continuation (verified structurally via draft_ngram on the
+    layout init_spec_state builds)."""
+    from mlmicroservicetemplate_tpu.models import t5 as t5_mod
+
+    cfg = t5_mod.T5Config(
+        vocab_size=23, d_model=32, d_kv=8, num_heads=2, d_ff=64,
+        num_layers=2,
+    )
+    params = t5_mod.init_params(jax.random.PRNGKey(0), cfg)
+    ids = np.array([[9, 4, 7, 5, 1]], np.int32)  # doc ends with eos
+    mask = np.ones_like(ids)
+    enc = t5_mod.encode(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    state = t5_mod.init_decode_state(params, cfg, enc, jnp.asarray(mask), 8)
+    ss = t5_mod.init_spec_state(state, jnp.asarray(ids), jnp.asarray(mask))
+    hist = np.asarray(ss.history)
+    # Layout: [enc ids | decoder_start at S_enc | -1 ...].
+    assert hist[0, :5].tolist() == [9, 4, 7, 5, 1]
+    assert hist[0, 5] == cfg.decoder_start_id
+    assert (hist[0, 6:] == -1).all()
+    # Pretend the model just emitted 4 (history pos 6, cache pos 1):
+    # unigram lookup at write_idx=1 (history 6) must draft the encoder
+    # continuation after the most recent earlier 4 → [7, 5, 1].
+    hist2 = jnp.asarray(hist).at[0, 6].set(4)
+    d = np.asarray(
+        spec_mod.draft_ngram(
+            hist2, jnp.asarray(np.array([6], np.int32)), 3, 1
+        )
+    )
+    assert d.tolist() == [[7, 5, 1]]
+
+
 def test_spec_accepts_on_cyclic_generation():
     """Once greedy generation falls into a cycle (tiny vocab makes this
     near-certain), prompt-lookup drafts from the generated history and
@@ -294,7 +417,7 @@ def test_spec_routing_load_gate():
 
     with pytest.raises(ValueError, match="SPEC_DECODE is not supported"):
         build_model(ServiceConfig(
-            device="cpu", model_name="t5-small", spec_decode="ngram"
+            device="cpu", model_name="bert-base", spec_decode="ngram"
         ))
 
     bundle = _tiny_gpt_bundle()
@@ -371,6 +494,53 @@ def test_spec_composes_with_prefix_cache():
     ref = np.concatenate(list(eng_spec.generate_stream(dict(feats))))
     np.testing.assert_array_equal(both, ref)
     assert eng_both.prefix_cache.contains(longer, 64)
+
+
+def test_engine_spec_t5_token_identity():
+    """SPEC_DECODE through the engine for T5: streamed and batched
+    greedy outputs identical to the spec-off engine."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from helpers import tiny_t5_bundle
+
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = tiny_t5_bundle()
+    common = dict(
+        device="cpu", warmup=False, batch_buckets=(1, 2), seq_buckets=(32,),
+        max_decode_len=24, stream_chunk_tokens=4,
+    )
+    eng_on = InferenceEngine(
+        bundle,
+        ServiceConfig(spec_decode="ngram", spec_k=4, spec_max_streams=4,
+                      **common),
+        ReplicaSet(make_mesh(1)),
+    )
+    eng_off = InferenceEngine(
+        bundle, ServiceConfig(**common), ReplicaSet(make_mesh(1))
+    )
+    assert eng_on.spec_enabled
+
+    for text in ("the cat sat on the mat, the cat sat", "abcd"):
+        ids, mask = bundle.tokenizer.encode(text, 32)
+        feats = {"input_ids": ids, "length": np.int32(int(mask.sum()))}
+        on = np.concatenate(list(eng_on.generate_stream(dict(feats))))
+        off = np.concatenate(list(eng_off.generate_stream(dict(feats))))
+        n = min(len(on), len(off))
+        np.testing.assert_array_equal(on[:n], off[:n], err_msg=text)
+        # A shorter spec stream must have stopped FOR a reason: EOS
+        # (early stop without it would be silent truncation).
+        if len(on) < len(off):
+            assert on[-1] == bundle.cfg.eos_id
+        # Non-streaming: run_batch routes all-greedy B<=spec_max_streams
+        # batches through _full_spec.
+        b_on = eng_on.run_batch([dict(feats)])
+        b_off = eng_off.run_batch([dict(feats)])
+        np.testing.assert_array_equal(b_on[0], b_off[0], err_msg=text)
 
 
 def test_draft_ngram_fallback_to_shorter_n():
