@@ -145,17 +145,26 @@ class InferenceEngine:
                 and bundle.spec_chunk_fn is not None
             )
             self.spec_k = int(getattr(cfg, "spec_k", 8))
+            # Rejection-sampling acceptance extends speculation to
+            # temperature>0 traffic (distribution-identical; see
+            # models/spec._sampled_emission and the SPEC_SAMPLED knob).
+            self.spec_sampled = self.spec_enabled and bool(
+                getattr(cfg, "spec_sampled", True)
+            )
             if self.spec_enabled:
                 def spec_start(p, ids, mask, sp, max_len: int,
-                               n_verify: int, spec_k: int):
+                               n_verify: int, spec_k: int,
+                               sample: bool = False):
                     enc = bundle.encode_fn(p, ids, mask)
                     state = bundle.init_state_fn(p, enc, mask, max_len, sample=sp)
                     ss = bundle.init_spec_fn(state, ids, mask)
-                    return bundle.spec_chunk_fn(p, ss, n_verify, spec_k)
+                    return bundle.spec_chunk_fn(p, ss, n_verify, spec_k, sample)
 
-                self._spec_start = jax.jit(spec_start, static_argnums=(4, 5, 6))
+                self._spec_start = jax.jit(
+                    spec_start, static_argnums=(4, 5, 6, 7)
+                )
                 self._spec_chunk = jax.jit(
-                    bundle.spec_chunk_fn, static_argnums=(2, 3)
+                    bundle.spec_chunk_fn, static_argnums=(2, 3, 4)
                 )
 
                 # Non-streaming greedy batches take the speculative
@@ -163,7 +172,7 @@ class InferenceEngine:
                 # verify rounds — same accepted-token economics as the
                 # streaming path, for /v1 clients that don't stream.
                 def full_spec(p, ids, mask, sp, budgets, max_len: int,
-                              spec_k: int):
+                              spec_k: int, sample: bool = False):
                     from jax import lax
 
                     enc = bundle.encode_fn(p, ids, mask)
@@ -179,7 +188,7 @@ class InferenceEngine:
                     def body(s):
                         import jax.numpy as jnp
 
-                        s2, _, _ = bundle.spec_chunk_fn(p, s, 1, spec_k)
+                        s2, _, _ = bundle.spec_chunk_fn(p, s, 1, spec_k, sample)
                         # Budget-capped rows stop once they have
                         # OVERSHOT the cap (≥1 past it, like _full's
                         # chunk granularity): the host trims to
@@ -198,7 +207,7 @@ class InferenceEngine:
                     ss = lax.while_loop(cond, body, ss)
                     return ss.base.tokens, ss.base.pos.max()
 
-                self._full_spec = jax.jit(full_spec, static_argnums=(5, 6))
+                self._full_spec = jax.jit(full_spec, static_argnums=(5, 6, 7))
 
             # Per-request prefix cache (PREFIX_CACHE=1, decoder
             # families without a global PROMPT_PREFIX): recurring
@@ -244,7 +253,8 @@ class InferenceEngine:
                 if self.spec_enabled:
                     def spec_start_prefixed(p, pkv, pref_ids, ids, mask,
                                             sp, max_len: int,
-                                            n_verify: int, spec_k: int):
+                                            n_verify: int, spec_k: int,
+                                            sample: bool = False):
                         p2 = dict(p, __prefix__=pkv)
                         enc = bundle.encode_fn(p2, ids, mask)
                         state = bundle.init_state_fn(
@@ -253,14 +263,17 @@ class InferenceEngine:
                         ss = bundle.init_spec_fn(
                             state, ids, mask, prefix_ids=pref_ids
                         )
-                        return bundle.spec_chunk_fn(p2, ss, n_verify, spec_k)
+                        return bundle.spec_chunk_fn(
+                            p2, ss, n_verify, spec_k, sample
+                        )
 
                     self._spec_start_prefixed = jax.jit(
-                        spec_start_prefixed, static_argnums=(6, 7, 8)
+                        spec_start_prefixed, static_argnums=(6, 7, 8, 9)
                     )
         else:
             self._forward = jax.jit(bundle.forward)
             self.spec_enabled = False
+            self.spec_sampled = False
             self.prefix_cache = None
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
@@ -382,14 +395,18 @@ class InferenceEngine:
                 # as stream routing): at large batches the
                 # (spec_k+1)-wide verify window stops hiding under
                 # weight streaming and low-acceptance traffic would
-                # regress below the chunked scan.
-                spec_batch = self.spec_enabled and not sampled and n <= int(
-                    getattr(self.cfg, "spec_max_streams", 1)
+                # regress below the chunked scan.  Sampled rows ride
+                # the same window via rejection-sampling acceptance
+                # unless SPEC_SAMPLED=0 opted out.
+                spec_batch = (
+                    self.spec_enabled
+                    and (not sampled or self.spec_sampled)
+                    and n <= int(getattr(self.cfg, "spec_max_streams", 1))
                 )
                 if spec_batch:
                     tokens, steps = self._full_spec(
                         self.params, ids, mask, sp, budgets,
-                        self.max_decode_len, self.spec_k,
+                        self.max_decode_len, self.spec_k, sampled,
                     )
                 else:
                     tokens, steps = self._full(
@@ -513,10 +530,12 @@ class InferenceEngine:
 
         if self.bundle.kind != KIND_SEQ2SEQ:
             raise ValueError(f"{self.bundle.name} does not support streaming")
-        if self.spec_enabled and float(feats.get("temperature", 0.0)) == 0.0:
-            # Greedy streams take the speculative path; sampled ones
-            # fall through (acceptance is an argmax identity — there is
-            # no greedy target to verify against when sampling).
+        if self.spec_enabled and (
+            float(feats.get("temperature", 0.0)) == 0.0 or self.spec_sampled
+        ):
+            # Greedy streams verify by argmax identity; sampled ones by
+            # rejection sampling (SPEC_SAMPLED=0 opts them back out to
+            # the normal chunked path for cross-path seed stability).
             yield from self._spec_stream(feats)
             return
         with self._lock:
@@ -562,6 +581,9 @@ class InferenceEngine:
         budget = self.budget_for(feats)
         row_ids = np.asarray(feats["input_ids"], np.int32)[: int(feats["length"])]
         length = int(feats["length"])
+        # Static executable variant: rejection-sampling acceptance for
+        # temperature>0 requests (generate_stream gated on spec_sampled).
+        sampled = float(feats.get("temperature", 0.0)) > 0.0
         with self._lock:
             hit = None
             if self.prefix_cache is not None:
@@ -580,7 +602,7 @@ class InferenceEngine:
                 ids, mask = self.replicas.place_batch(ids, mask)
                 ss, out, ns = self._spec_start_prefixed(
                     self.params, pkv, row_ids[:p_len], ids, mask,
-                    sp, self.max_decode_len, n_verify, self.spec_k,
+                    sp, self.max_decode_len, n_verify, self.spec_k, sampled,
                 )
                 # Growing conversations keep donating from the hit
                 # path (same rule as start_fused): capture the largest
@@ -600,7 +622,7 @@ class InferenceEngine:
                 ids, mask = self.replicas.place_batch(ids, mask)
                 ss, out, ns = self._spec_start(
                     self.params, ids, mask, sp,
-                    self.max_decode_len, n_verify, self.spec_k,
+                    self.max_decode_len, n_verify, self.spec_k, sampled,
                 )
                 if self.prefix_cache is not None:
                     p_ins = self.prefix_cache.bucket_for_insert(length)
@@ -633,13 +655,13 @@ class InferenceEngine:
             with self._lock:
                 if ahead is None:
                     ahead = self._spec_chunk(
-                        self.params, ss, n_verify, self.spec_k
+                        self.params, ss, n_verify, self.spec_k, sampled
                     )
                 ss, out, ns = ahead
                 ahead = None
                 if produced + n_verify < budget:  # ≥1 token per round
                     ahead = self._spec_chunk(
-                        self.params, ss, n_verify, self.spec_k
+                        self.params, ss, n_verify, self.spec_k, sampled
                     )
                 for arr in (out, ns, ss.base.done):
                     try:
@@ -778,34 +800,64 @@ class InferenceEngine:
                                         self._capture_prefix(st2, p_ins)
                                 # Spec × prefix composition: the
                                 # prefixed spec start + its follow-up
-                                # spec chunk per (prefix, suffix) pair.
+                                # spec chunk per (prefix, suffix) pair,
+                                # in every served sample variant.
                                 if self.spec_enabled:
-                                    ss3, out3, _ = self._spec_start_prefixed(
-                                        self.params, pkv,
-                                        np.ones(p_len, np.int32), sids,
-                                        smask, ssp, self.max_decode_len,
-                                        self.chunk_tokens, self.spec_k,
-                                    )
-                                    ss3, out3, _ = self._spec_chunk(
-                                        self.params, ss3,
-                                        self.chunk_tokens, self.spec_k,
-                                    )
-                                    jax.device_get(out3)
+                                    for sflag in (
+                                        (False, True)
+                                        if (warm_sampled and self.spec_sampled)
+                                        else (False,)
+                                    ):
+                                        ss3, out3, _ = self._spec_start_prefixed(
+                                            self.params, pkv,
+                                            np.ones(p_len, np.int32), sids,
+                                            smask, ssp, self.max_decode_len,
+                                            self.chunk_tokens, self.spec_k,
+                                            sflag,
+                                        )
+                                        ss3, out3, _ = self._spec_chunk(
+                                            self.params, ss3,
+                                            self.chunk_tokens, self.spec_k,
+                                            sflag,
+                                        )
+                                        jax.device_get(out3)
                 # Speculative start + follow-up chunk compile per seq
-                # bucket too (history/cache shapes depend on it).
+                # bucket too (history/cache shapes depend on it); the
+                # rejection-sampling variants are distinct executables.
                 if self.spec_enabled:
+                    spec_variants = (
+                        (False, True)
+                        if (warm_sampled and self.spec_sampled)
+                        else (False,)
+                    )
                     with self._lock:
                         ids, mask, _ = self._collate_text([feats])
                         sp, _ = self._collate_sample([feats], ids.shape[0])
                         ids, mask = self.replicas.place_batch(ids, mask)
-                        ss, out, ns = self._spec_start(
-                            self.params, ids, mask, sp,
-                            self.max_decode_len, self.chunk_tokens, self.spec_k,
-                        )
-                        ss, out, ns = self._spec_chunk(
-                            self.params, ss, self.chunk_tokens, self.spec_k
-                        )
-                        jax.device_get(out)
+                        for sflag in spec_variants:
+                            ss, out, ns = self._spec_start(
+                                self.params, ids, mask, sp,
+                                self.max_decode_len, self.chunk_tokens,
+                                self.spec_k, sflag,
+                            )
+                            ss, out, ns = self._spec_chunk(
+                                self.params, ss, self.chunk_tokens,
+                                self.spec_k, sflag,
+                            )
+                            jax.device_get(out)
+                    # _full_spec warms explicitly at n=1 ONLY when the
+                    # pad-multiple filter removed batch bucket 1 above
+                    # (REPLICAS>1): otherwise no warmup batch routes
+                    # through the spec while_loop and the first
+                    # non-streaming greedy request would compile on the
+                    # request path.  (At REPLICAS=1 the bucket loop
+                    # already covered it — don't re-decode the budget.)
+                    if 1 not in batch_buckets:
+                        self.run_batch([dict(feats)])
+                        if warm_sampled and self.spec_sampled:
+                            self.run_batch(
+                                [dict(feats, temperature=1.0, seed=0)]
+                            )
         dt = time.monotonic() - t0
         log.info("warmup compiled %s buckets in %.1fs", self.bundle.name, dt)
         return dt

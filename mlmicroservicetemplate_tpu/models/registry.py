@@ -68,9 +68,10 @@ class ModelBundle:
     # the GPTState layout); encoder-decoders need their own history
     # layout — t5.init_spec_state prepends the ENCODER ids so lookup
     # drafts from the document.  spec_chunk_fn(params, spec_state,
-    # n_verify, spec_k) -> (SpecState, out [B,nv,K+1], n_emit [B,nv])
-    # runs n_verify draft→verify rounds in one dispatch.  None =
-    # family does not support SPEC_DECODE.
+    # n_verify, spec_k, sample=False) -> (SpecState, out [B,nv,K+1],
+    # n_emit [B,nv]) runs n_verify draft→verify rounds in one dispatch;
+    # ``sample`` (static) turns on rejection-sampling acceptance for
+    # temperature>0 rows.  None = family does not support SPEC_DECODE.
     init_spec_fn: Callable | None = None
     spec_chunk_fn: Callable | None = None
 
@@ -473,11 +474,12 @@ def _build_t5(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def init_spec_fn(state, input_ids, attention_mask, prefix_ids=None):
         return t5_mod.init_spec_state(state, input_ids, attention_mask)
 
-    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int,
+                      sample: bool = False):
         return spec_mod.spec_chunk(
             p, spec_state, n_verify, spec_k, int(svc_cfg.spec_ngram),
             lambda pp, st, toks: t5_mod.multi_step(pp, cfg, st, toks),
-            cfg.eos_id, cfg.pad_id,
+            cfg.eos_id, cfg.pad_id, sample,
         )
 
     return ModelBundle(
@@ -567,11 +569,12 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 
     init_spec_fn = spec_mod.make_init_spec_fn(p_len)
 
-    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int,
+                      sample: bool = False):
         return spec_mod.spec_chunk(
             p, spec_state, n_verify, spec_k, int(svc_cfg.spec_ngram),
             lambda pp, st, toks: gpt_mod.multi_step(pp, cfg, st, toks),
-            cfg.eos_id, cfg.pad_id,
+            cfg.eos_id, cfg.pad_id, sample,
         )
 
     return ModelBundle(
@@ -684,11 +687,12 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 
     init_spec_fn = spec_mod.make_init_spec_fn(p_len)
 
-    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int,
+                      sample: bool = False):
         return spec_mod.spec_chunk(
             p, spec_state, n_verify, spec_k, int(svc_cfg.spec_ngram),
             lambda pp, st, toks: llama_mod.multi_step(pp, cfg, st, toks),
-            cfg.eos_id, cfg.pad_id,
+            cfg.eos_id, cfg.pad_id, sample,
         )
 
     return ModelBundle(

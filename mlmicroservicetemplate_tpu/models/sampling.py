@@ -99,6 +99,42 @@ def _filter_top_p(logits: jax.Array, top_p: jax.Array, sorted_desc: jax.Array) -
     return jnp.where(keep, logits, _NEG_INF)
 
 
+def filtered_logits(
+    logits: jax.Array,  # [B, V]
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+) -> jax.Array:
+    """The temperature/top-k/top-p transform as f32 logits (filtered
+    entries at -inf): softmax of the result IS the distribution a
+    sampled row draws from.  One home for the filter order (HF:
+    temperature, then top-k, then top-p) — the sequential sampler and
+    the speculative rejection sampler (spec.py) must agree exactly or
+    spec stops being distribution-identical."""
+    # Temperature first, guarded against div-by-zero for greedy rows
+    # whose sampled value is discarded anyway.
+    z = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
+    v = z.shape[-1]
+    sorted_desc = -jnp.sort(-z, axis=-1)  # descending — the ONE sort
+    z = _filter_top_k(z, top_k, sorted_desc)
+    # The sorted view of the top-k-filtered dist is derivable from the
+    # first sort by masking its tail — no second O(V log V) sort on the
+    # per-token hot path.
+    eff_k = jnp.where(top_k > 0, top_k, v)[:, None]
+    sorted_desc2 = jnp.where(
+        jnp.arange(v)[None, :] < eff_k, sorted_desc, _NEG_INF
+    )
+    return _filter_top_p(z, top_p, sorted_desc2)
+
+
+def row_split(k):
+    """Per-row key chain: split -> (next chain, this step's key), so a
+    row's randomness is independent of batch composition.  ``k`` is a
+    [2] u32 raw key; returns ([2] u32 next chain, typed step key)."""
+    nk, sk = jax.random.split(jax.random.wrap_key_data(k, impl="threefry2x32"))
+    return jax.random.key_data(nk), sk
+
+
 def select_token(logits: jax.Array, sp: SampleParams) -> tuple[jax.Array, SampleParams]:
     """Pick the next token per row: argmax where temperature <= 0,
     filtered categorical sample elsewhere.  Returns (tokens [B] i32,
@@ -109,27 +145,7 @@ def select_token(logits: jax.Array, sp: SampleParams) -> tuple[jax.Array, Sample
     no-sampling fast path.
     """
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    # Temperature first (HF order), guarded against div-by-zero for
-    # greedy rows whose sampled value is discarded anyway.
-    z = logits.astype(jnp.float32) / jnp.maximum(sp.temperature, 1e-6)[:, None]
-    v = z.shape[-1]
-    sorted_desc = -jnp.sort(-z, axis=-1)  # descending — the ONE sort
-    z = _filter_top_k(z, sp.top_k, sorted_desc)
-    # The sorted view of the top-k-filtered dist is derivable from the
-    # first sort by masking its tail — no second O(V log V) sort on the
-    # per-token hot path.
-    eff_k = jnp.where(sp.top_k > 0, sp.top_k, v)[:, None]
-    sorted_desc2 = jnp.where(
-        jnp.arange(v)[None, :] < eff_k, sorted_desc, _NEG_INF
-    )
-    z = _filter_top_p(z, sp.top_p, sorted_desc2)
-
-    # Per-row key chain: split -> (next chain, this step's key), so a
-    # row's randomness is independent of batch composition.
-    def row_split(k):
-        nk, sk = jax.random.split(jax.random.wrap_key_data(k, impl="threefry2x32"))
-        return jax.random.key_data(nk), sk
-
+    z = filtered_logits(logits, sp.temperature, sp.top_k, sp.top_p)
     next_rng, step_keys = jax.vmap(row_split)(sp.rng)
     sampled = jax.vmap(jax.random.categorical)(step_keys, z).astype(jnp.int32)
     tok = jnp.where(sp.temperature > 0.0, sampled, greedy_tok)

@@ -148,6 +148,73 @@ def draft_ngram(
     return jnp.where(j[:, None] >= 0, draft, jnp.int32(-1))
 
 
+def _sampled_emission(logits, draft, sp, spec_k: int):
+    """Rejection-sampling acceptance for deterministic (point-mass)
+    drafts — the standard speculative-sampling result specialized to
+    prompt-lookup: the draft proposal q is a point mass at draft_i, so
+
+    - accept draft_i with prob p_{i-1}(draft_i), where p is the row's
+      temperature/top-k/top-p-FILTERED distribution (must be the same
+      transform the sequential sampler applies — sampling.filtered_logits);
+    - on first rejection, resample from the residual norm(max(0, p - q))
+      = p with the rejected token's mass removed, renormalized;
+    - if all K accepted, the bonus token samples from p_K directly.
+
+    Marginally each emitted position is distributed EXACTLY as
+    sequential ancestral sampling (the accepted-mass + residual-mass
+    split reconstructs p), so the output distribution is identical —
+    only the randomness CONSUMPTION differs, which is why seeded
+    sequences differ across the spec/non-spec paths while each path
+    stays deterministic per seed (tested in test_spec_sampled.py).
+
+    A -1 draft slot (no n-gram match) never had a proposal: acceptance
+    is forced false and the "residual" keeps full p (nothing to remove).
+    Returns (cand [B, K+1] emission candidates, m [B] accepted counts,
+    next_rng [B, 2])."""
+    from .sampling import filtered_logits, row_split
+
+    b, width, v = logits.shape
+    rep = lambda a: jnp.repeat(a, width, axis=0)
+    z = filtered_logits(
+        logits.reshape(b * width, v),
+        rep(sp.temperature), rep(sp.top_k), rep(sp.top_p),
+    ).reshape(b, width, v)
+    probs = jax.nn.softmax(z, axis=-1)  # [B, W, V] f32
+    clip_d = jnp.clip(draft, 0, v - 1)
+    p_draft = jnp.take_along_axis(
+        probs[:, :spec_k, :], clip_d[:, :, None], axis=-1
+    )[..., 0]  # [B, K]
+
+    next_rng, step_keys = jax.vmap(row_split)(sp.rng)
+    u = jax.vmap(
+        lambda k: jax.random.uniform(jax.random.fold_in(k, 0), (spec_k,))
+    )(step_keys)  # [B, K]
+    accept = (u < p_draft) & (draft >= 0)
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)  # [B]
+
+    # Final token: residual at the rejection slot, or bonus at slot K.
+    probs_m = jnp.take_along_axis(probs, m[:, None, None], axis=1)[:, 0]  # [B, V]
+    rej_slot = jnp.minimum(m, spec_k - 1)[:, None]
+    rej_tok = jnp.take_along_axis(clip_d, rej_slot, axis=1)[:, 0]  # [B]
+    rej_valid = (m < spec_k) & (
+        jnp.take_along_axis(draft, rej_slot, axis=1)[:, 0] >= 0
+    )
+    final_p = jnp.where(
+        (jnp.arange(v)[None] == rej_tok[:, None]) & rej_valid[:, None],
+        0.0, probs_m,
+    )
+    final_logits = jnp.log(jnp.maximum(final_p, jnp.float32(1e-38)))
+    e = jax.vmap(
+        lambda k, lg: jax.random.categorical(jax.random.fold_in(k, 1), lg)
+    )(step_keys, final_logits).astype(jnp.int32)
+
+    # Candidate emissions: the m accepted drafts, then the sampled token.
+    offs = jnp.arange(width)[None]
+    draft_pad = jnp.concatenate([draft, draft[:, :1]], axis=1)  # [B, W]
+    cand = jnp.where(offs == m[:, None], e[:, None], draft_pad)
+    return cand, m, next_rng.astype(jnp.uint32)
+
+
 def verify_step(
     params,
     spec_state: SpecState,
@@ -156,6 +223,7 @@ def verify_step(
     multi_fn: Callable,  # (params, base_state, tokens [B,D]) -> (k, v, logits [B,D,V])
     eos_id: int,
     pad_id: int,
+    sample: bool = False,
 ):
     """One draft→verify→accept round.  Returns (state', out [B, K+1],
     n_emit [B]): ``out[:, :n_emit]`` are the emitted tokens (padded with
@@ -169,6 +237,15 @@ def verify_step(
     where draft == g[:, :K] — because only then was x_{i+1} the token
     greedy would have fed next.  m accepted drafts ⇒ m+1 emitted tokens
     (the bonus token g_m comes free from the verify logits).
+
+    ``sample`` (static) additionally runs rejection-sampling acceptance
+    for rows with temperature>0 (``_sampled_emission``): accepted
+    drafts ARE the emissions there, and the (m+1)-th token is sampled
+    from the residual/bonus distribution — distribution-identical to
+    sequential sampling.  Greedy rows in the same batch keep the argmax
+    rule; cache discipline is unchanged either way because the window
+    K/V at position t+1+j always came from draft_{j+1}, which is
+    exactly the token emitted at offset j on both rules.
 
     Cache/state discipline: K/V for ALL window positions are written
     before acceptance is known; only accepted positions get key_valid
@@ -201,15 +278,23 @@ def verify_step(
     match = draft == g[:, :spec_k]
     # Longest accepted prefix: count of leading True.
     m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)  # [B]
-    emit_raw = offs <= m[:, None]  # candidates g_0..g_m
-    is_eos = (g == jnp.int32(eos_id)) & emit_raw
+    cand = g
+    sp = st.sample
+    if sample:
+        cand_s, m_s, next_rng = _sampled_emission(logits, draft, sp, spec_k)
+        is_samp = sp.temperature > 0.0
+        cand = jnp.where(is_samp[:, None], cand_s, g)
+        m = jnp.where(is_samp, m_s, m)
+        sp = sp._replace(rng=next_rng)
+    emit_raw = offs <= m[:, None]  # candidates cand_0..cand_m
+    is_eos = (cand == jnp.int32(eos_id)) & emit_raw
     has_eos = is_eos.any(axis=1)
     eos_idx = jnp.where(has_eos, jnp.argmax(is_eos, axis=1), width)
     # Emit through the first EOS inclusive, like the sequential path.
     n_emit = jnp.minimum(m + 1, eos_idx + 1)
     n_emit = jnp.where(st.done, 0, n_emit).astype(jnp.int32)
     emit = offs < n_emit[:, None]  # [B, width]
-    out = jnp.where(emit, g, jnp.int32(pad_id))
+    out = jnp.where(emit, cand, jnp.int32(pad_id))
 
     total = st.key_valid.shape[1]
     sentinel_tok = st.tokens.shape[1]  # OOB ⇒ mode="drop"
@@ -229,7 +314,7 @@ def verify_step(
     ].set(out, mode="drop")
     last = jnp.where(
         n_emit > 0,
-        jnp.take_along_axis(g, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(cand, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
         st.last_token,
     )
     base = st._replace(
@@ -241,6 +326,7 @@ def verify_step(
         last_token=last,
         done=st.done | has_eos,
         tokens=tokens_buf,
+        sample=sp,
     )
     return SpecState(base=base, history=hist), out, n_emit
 
@@ -254,16 +340,18 @@ def spec_chunk(
     multi_fn: Callable,
     eos_id: int,
     pad_id: int,
+    sample: bool = False,
 ):
     """``n_verify`` verify rounds in one compiled scan — the spec-path
     chunk contract.  Returns (state', out [B, n_verify, K+1], n_emit
     [B, n_verify]): each round emits between 1 and K+1 tokens per live
     row (0 once done), so one dispatch yields ≥ n_verify tokens and up
-    to n_verify·(K+1)."""
+    to n_verify·(K+1).  ``sample`` is STATIC: True compiles the
+    rejection-sampling acceptance path for temperature>0 rows."""
 
     def step(s, _):
         s2, out, n = verify_step(
-            params, s, spec_k, ngram_n, multi_fn, eos_id, pad_id
+            params, s, spec_k, ngram_n, multi_fn, eos_id, pad_id, sample
         )
         return s2, (out, n)
 

@@ -129,17 +129,20 @@ class Batcher:
         half-consumed) generator cannot leak a slot."""
         if self._closed:
             raise RuntimeError("batcher is stopped")
-        # SPEC_DECODE routes greedy streams to the per-stream path
-        # (where the speculative executables live) ONLY in the
-        # low-concurrency regime it targets (< spec_max_streams
-        # active): under load, one shared batched dispatch for all
-        # streams beats N serialized speculative loops, so traffic
-        # falls back to the continuous loop.  Sampled streams (no
-        # greedy target to verify) always keep the shared loop.
+        # SPEC_DECODE routes streams to the per-stream path (where the
+        # speculative executables live) ONLY in the low-concurrency
+        # regime it targets (< spec_max_streams active): under load,
+        # one shared batched dispatch for all streams beats N
+        # serialized speculative loops, so traffic falls back to the
+        # continuous loop.  Sampled streams speculate via rejection-
+        # sampling acceptance unless SPEC_SAMPLED=0 opted them out.
         cdl_admitted = self._cdl._admitted if self._cdl is not None else 0
         spec_route = (
             getattr(self.engine, "spec_enabled", False)
-            and float(feats.get("temperature", 0.0)) == 0.0
+            and (
+                float(feats.get("temperature", 0.0)) == 0.0
+                or getattr(self.engine, "spec_sampled", False)
+            )
             and (self._active_streams + cdl_admitted)
             < int(getattr(self.engine.cfg, "spec_max_streams", 1))
         )

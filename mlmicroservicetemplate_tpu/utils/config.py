@@ -114,15 +114,15 @@ class ServiceConfig(BaseModel):
     # BASELINE.md.  Mutually exclusive with prefix caching.
     quant_kv: str | None = None
 
-    # Speculative decoding for decoder-only families (gpt2/llama):
+    # Speculative decoding for generative families (gpt2/llama/t5):
     # "ngram" drafts the next SPEC_K tokens by prompt-lookup (the last
     # SPEC_NGRAM generated tokens are matched against the prompt +
-    # generation history) and verifies all of them in ONE forward —
+    # generation history — for T5, against the ENCODER input, where
+    # summaries quote from) and verifies all of them in ONE forward —
     # the only lever past the HBM ceiling at batch=1, where each step
-    # otherwise streams the full weights for one token.  Greedy streams
-    # only (sampled requests fall back to normal decode); emitted
-    # tokens are exactly the verify-forward's greedy argmax at every
-    # position, so output == non-speculative greedy.
+    # otherwise streams the full weights for one token.  Greedy output
+    # is exactly the verify-forward's argmax at every position, so
+    # output == non-speculative greedy.
     spec_decode: str | None = None
     # Draft length per verify step (tokens checked per forward).
     spec_k: int = 8
@@ -134,6 +134,15 @@ class ServiceConfig(BaseModel):
     # batched dispatch for all streams beats per-stream speculation
     # under concurrency — speculation is the B=1 latency lever).
     spec_max_streams: int = 1
+    # Rejection-sampling acceptance for temperature>0 requests (accept
+    # draft_i with prob p(draft_i) under the filtered distribution;
+    # resample the residual on reject): DISTRIBUTION-identical to
+    # sequential sampling, but consumes randomness differently, so a
+    # seeded request's exact tokens depend on which path served it
+    # (each path is itself deterministic per seed).  SPEC_SAMPLED=0
+    # restores strict cross-path seed reproducibility by routing all
+    # sampled traffic to the normal chunked path.
+    spec_sampled: bool = True
 
     # Shared prompt prefix (system prompt) for decoder models
     # (gpt2/llama): its KV is computed ONCE at startup and cached, so
@@ -304,6 +313,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PREFIX_CACHE")
     if v is not None:
         kwargs["prefix_cache"] = v.lower() not in ("0", "false", "no")
+    v = get("SPEC_SAMPLED")
+    if v is not None:
+        kwargs["spec_sampled"] = v.lower() not in ("0", "false", "no")
     v = get("PREFIX_CACHE_MB")
     if v is not None:
         kwargs["prefix_cache_mb"] = float(v)

@@ -121,11 +121,12 @@ def tiny_t5_bundle(seed: int = 0) -> ModelBundle:
     def init_spec_fn(state, input_ids, attention_mask, prefix_ids=None):
         return t5_mod.init_spec_state(state, input_ids, attention_mask)
 
-    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int,
+                      sample: bool = False):
         return spec_mod.spec_chunk(
             p, spec_state, n_verify, spec_k, 2,
             lambda pp, st, toks: t5_mod.multi_step(pp, cfg, st, toks),
-            cfg.eos_id, cfg.pad_id,
+            cfg.eos_id, cfg.pad_id, sample,
         )
 
     return ModelBundle(

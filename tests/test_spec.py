@@ -291,11 +291,12 @@ def _tiny_gpt_bundle(seed: int = 0):
 
     init_spec_fn = spec_mod.make_init_spec_fn(0)
 
-    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int):
+    def spec_chunk_fn(p, spec_state, n_verify: int, spec_k: int,
+                      sample: bool = False):
         return spec_mod.spec_chunk(
             p, spec_state, n_verify, spec_k, 2,
             lambda pp, st, toks: gpt_mod.multi_step(pp, cfg, st, toks),
-            cfg.eos_id, cfg.pad_id,
+            cfg.eos_id, cfg.pad_id, sample,
         )
 
     return ModelBundle(
@@ -322,7 +323,9 @@ def test_engine_spec_stream_token_identity():
         max_decode_len=24, stream_chunk_tokens=4,
     )
     eng_on = InferenceEngine(
-        bundle, ServiceConfig(spec_decode="ngram", spec_k=4, **common),
+        bundle,
+        ServiceConfig(spec_decode="ngram", spec_k=4, spec_sampled=False,
+                      **common),
         ReplicaSet(make_mesh(1)),
     )
     eng_off = InferenceEngine(
@@ -344,8 +347,10 @@ def test_engine_spec_stream_token_identity():
         ) or bundle.cfg.eos_id in off.tolist()
         np.testing.assert_array_equal(on[:n], off[:n], err_msg=text)
 
-    # Sampled request: same seeded stream on both engines (spec path
-    # must NOT intercept it).
+    # SPEC_SAMPLED=0 opt-out: a seeded sampled request streams the
+    # SAME tokens as the spec-off engine (strict cross-path seed
+    # reproducibility; the default-on rejection path is covered in
+    # test_spec_sampled.py).
     feats_s = dict(feats, temperature=1.0, seed=7)
     s_on = np.concatenate(list(eng_on.generate_stream(dict(feats_s))))
     s_off = np.concatenate(list(eng_off.generate_stream(dict(feats_s))))
@@ -425,6 +430,7 @@ def test_spec_routing_load_gate():
         device="cpu", warmup=False, batch_buckets=(1, 2), seq_buckets=(32,),
         max_decode_len=8, stream_chunk_tokens=4, spec_decode="ngram",
         spec_k=4, spec_max_streams=1, batch_timeout_ms=1.0,
+        spec_sampled=False,
     )
     eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
     batcher = Batcher(eng, cfg)
@@ -440,11 +446,43 @@ def test_spec_routing_load_gate():
         async for _ in gen:
             pass
         assert batcher._cdl.prefill_dispatches == 0
-        # Sampled: always the loop.
+        # Sampled with SPEC_SAMPLED=0: always the loop.
         gen = batcher.submit_stream(dict(feats, temperature=1.0, seed=1))
         async for _ in gen:
             pass
         assert batcher._cdl.prefill_dispatches == 1
+        await batcher.stop()
+
+    asyncio.run(body())
+
+
+def test_spec_routing_sampled_default():
+    """With SPEC_SAMPLED on (the default), an idle sampled stream takes
+    the speculative per-stream path instead of the continuous loop."""
+    from mlmicroservicetemplate_tpu.scheduler import Batcher
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = _tiny_gpt_bundle()
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2), seq_buckets=(32,),
+        max_decode_len=8, stream_chunk_tokens=4, spec_decode="ngram",
+        spec_k=4, spec_max_streams=1, batch_timeout_ms=1.0,
+    )
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    assert eng.spec_sampled
+    batcher = Batcher(eng, cfg)
+    ids, mask = bundle.tokenizer.encode("ab", 32)
+    feats = {"input_ids": ids, "length": np.int32(int(mask.sum()))}
+
+    import asyncio
+
+    async def body():
+        gen = batcher.submit_stream(dict(feats, temperature=1.0, seed=1))
+        async for _ in gen:
+            pass
+        assert batcher._cdl.prefill_dispatches == 0
         await batcher.stop()
 
     asyncio.run(body())
@@ -580,7 +618,7 @@ def test_full_spec_nonstream_token_identity():
     eng_on = InferenceEngine(
         bundle,
         ServiceConfig(spec_decode="ngram", spec_k=4, spec_max_streams=4,
-                      **common),
+                      spec_sampled=False, **common),
         ReplicaSet(make_mesh(1)),
     )
     eng_off = InferenceEngine(
