@@ -35,7 +35,7 @@ CHUNK = int(os.environ.get("BENCH_CHUNK", "8"))
 LEVELS = (1, 2, 4, 8)
 
 
-def _build(device: str):
+def _build(device: str, spec: bool = False):
     from mlmicroservicetemplate_tpu.engine import InferenceEngine
     from mlmicroservicetemplate_tpu.models.registry import build_model
     from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
@@ -46,6 +46,11 @@ def _build(device: str):
         batch_buckets=(1,), seq_buckets=(64,),
         max_decode_len=DECODE, stream_chunk_tokens=CHUNK, max_streams=max(LEVELS),
         quantize=os.environ.get("QUANTIZE") or None,
+        **(
+            {"spec_decode": "ngram", "spec_continuous": True,
+             "spec_k": int(os.environ.get("SPEC_K", "8"))}
+            if spec else {}
+        ),
     )
     bundle = build_model(cfg)
     eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
@@ -175,17 +180,37 @@ def main() -> None:
     # Warm both paths' executables off the clock.
     for _ in eng.generate_stream(dict(feats)):
         pass
+    # Third column: SPEC_CONTINUOUS (draft→verify rounds inside the
+    # shared chunk) — the VERDICT-r4 question is whether it holds >= the
+    # plain loop at every width.  BENCH_SPEC=0 skips it.
+    spec_on = os.environ.get("BENCH_SPEC", "1").lower() not in (
+        "0", "false", "no"
+    )
+    eng_s = cfg_s = None
+    if spec_on:
+        try:
+            eng_s, cfg_s, _ = _build(device, spec=True)
+        except Exception as e:
+            print(json.dumps({"spec_continuous_skipped": str(e)}), flush=True)
+            spec_on = False
 
     rows = []
     for n in LEVELS:
         legacy = _legacy(eng, feats, n)
         cont = _continuous(eng, cfg, feats, n)
-        rows.append({
+        row = {
             "streams": n,
             "legacy": legacy,
             "continuous": cont,
             "speedup": round(cont["tok_s"] / max(legacy["tok_s"], 1e-9), 2),
-        })
+        }
+        if spec_on:
+            spec = _continuous(eng_s, cfg_s, feats, n)
+            row["spec_continuous"] = spec
+            row["spec_vs_continuous"] = round(
+                spec["tok_s"] / max(cont["tok_s"], 1e-9), 2
+            )
+        rows.append(row)
         print(json.dumps(rows[-1]), flush=True)
     # Live-stream inter-token latency during admission, fix off vs on.
     stall = {

@@ -124,6 +124,34 @@ class ContinuousDecodeLoop:
         # prompts (engine pads past the bucket list for them) cannot be
         # inserted — the Batcher routes those to the per-stream path.
         self.max_prompt = max(engine.seq_buckets)
+        # SPEC_CONTINUOUS: the shared state carries per-row drafting
+        # histories and the shared chunk runs draft→verify rounds
+        # (models/spec.py), so every live stream keeps the accepted-
+        # token multiplier — each round emits 1..spec_k+1 tokens per
+        # row instead of exactly 1.  Excluded under the per-request
+        # prefix cache: hit states carry per-request shapes the shared
+        # slot batch cannot hold (build_model rejects the combination).
+        self.spec = bool(
+            getattr(cfg, "spec_continuous", False)
+            and getattr(engine, "spec_enabled", False)
+            and engine.prefix_cache is None
+        )
+        if getattr(cfg, "spec_continuous", False) and not self.spec:
+            raise ValueError(
+                "SPEC_CONTINUOUS needs SPEC_DECODE=ngram on a spec-capable "
+                "family and PREFIX_CACHE off"
+            )
+        # Decoder-only families place the prompt at [p_len, p_len+L) of
+        # the history (a startup PROMPT_PREFIX occupies [0, p_len) with
+        # unknown ids); encoder-decoders place the ENCODER ids at the
+        # front (t5.init_spec_state layout, offset read off the widths).
+        pre = (
+            engine.bundle.params.get("__prefix__")
+            if isinstance(engine.bundle.params, dict) else None
+        )
+        self._p_len = pre["k"][0].shape[1] if pre is not None else 0
+        self._hist_w: int | None = None  # set by _build_empty_state
+        self._kv_w: int | None = None
         # Slot count must divide over the replica mesh's batch axis.
         mult = engine.replicas.pad_multiple()
         self.n_slots = -(-self.max_streams // mult) * mult
@@ -401,7 +429,7 @@ class ContinuousDecodeLoop:
         on (hits need per-request shapes) or the wave is a single
         stream."""
         eng = self.engine
-        started: list[tuple[_Stream, Any, Any, bool, int]] = []
+        started: list[tuple] = []  # (st, state1, toks, sampled, row, ids, mask)
         ok: list[_Stream] = []
         for st in wave:
             if st.cancelled.is_set():
@@ -419,7 +447,7 @@ class ContinuousDecodeLoop:
         if not ok:
             return started
         with eng._lock:
-            if len(ok) == 1 or eng.prefix_cache is not None:
+            if (len(ok) == 1 or eng.prefix_cache is not None) and not self.spec:
                 for st in ok:
                     try:
                         # Fused prefill+first-chunk at the request's
@@ -432,16 +460,20 @@ class ContinuousDecodeLoop:
                         continue
                     self.prefill_dispatches += 1
                     prefetch_to_host(toks, state1.done)
-                    started.append((st, state1, toks, sampled, 0))
+                    started.append((st, state1, toks, sampled, 0, None, None))
                 return started
             try:
                 # Pad the wave to the full slot count so every wave
                 # size shares ONE (B, S) executable per seq bucket
                 # (zero-length pad rows collate to all-zero masks =
-                # born-done rows that never insert).
+                # born-done rows that never insert).  Spec mode admits
+                # solo streams at B=1 (no pad) but through the same
+                # collated path: the insert needs the ids/mask to build
+                # the row's spec base.
+                pad_to = 1 if (self.spec and len(ok) == 1) else self.n_slots
                 feats_list = [st.feats for st in ok] + [
                     {"input_ids": np.zeros(0, np.int32), "length": np.int32(0)}
-                ] * (self.n_slots - len(ok))
+                ] * (pad_to - len(ok))
                 ids, mask, _ = eng._collate_text(feats_list)
                 sp, sampled = eng._collate_sample(feats_list, ids.shape[0])
                 ids, mask = eng.replicas.place_batch(ids, mask)
@@ -461,7 +493,7 @@ class ContinuousDecodeLoop:
                 # wave must not pin 7 greedy streams' future chunks to
                 # the per-step [B, V] sort.
                 row_sampled = float(st.feats.get("temperature", 0.0)) > 0.0
-                started.append((st, state1, toks, row_sampled, row))
+                started.append((st, state1, toks, row_sampled, row, ids, mask))
         return started
 
     def _admit_complete(self, started: list) -> None:
@@ -475,7 +507,7 @@ class ContinuousDecodeLoop:
             return
         eng = self.engine
         uniq: dict[int, Any] = {}
-        for _, state1, toks, _, _ in started:
+        for _, state1, toks, _, _, _, _ in started:
             uniq.setdefault(id(toks), (toks, state1.done))
         with eng._lock:
             try:
@@ -486,7 +518,7 @@ class ContinuousDecodeLoop:
                 for st, *_ in started:
                     self._finish(st, e)
                 return
-        for st, state1, toks, sampled, row in started:
+        for st, state1, toks, sampled, row, ids, mask in started:
             toks_np, done_np = fetched[id(toks)]
             st.produced = eng.chunk_tokens
             st.emit(toks_np[row])
@@ -503,9 +535,16 @@ class ContinuousDecodeLoop:
                     self._build_empty_state()
                 slot = self.free.pop()
                 with eng._lock:
-                    self._state = self._insert_fn()(
-                        self._state, state1, np.int32(slot), np.int32(row)
-                    )
+                    if self.spec:
+                        self._state = self._insert_fn()(
+                            self._state, state1, ids, mask,
+                            self._hist_row(st.feats, toks_np[row]),
+                            np.int32(slot), np.int32(row),
+                        )
+                    else:
+                        self._state = self._insert_fn()(
+                            self._state, state1, np.int32(slot), np.int32(row)
+                        )
             except Exception as e:
                 if slot is not None:
                     self.free.append(slot)
@@ -517,7 +556,11 @@ class ContinuousDecodeLoop:
 
     def _build_empty_state(self) -> None:
         """All-slots-done decode state from a max-bucket prefill
-        template (shapes/dtypes only; every row starts dead)."""
+        template (shapes/dtypes only; every row starts dead).  Spec
+        mode wraps the template through the family's ``init_spec_fn``
+        so the slot state carries key_valid/write_idx (the spec base
+        contract) plus the [n_slots, hist_w] drafting history (-1 =
+        invalid everywhere until a tenant's row is inserted)."""
         import jax
 
         eng = self.engine
@@ -530,10 +573,27 @@ class ContinuousDecodeLoop:
             template, _ = eng._start(
                 eng.params, ids, mask, sp, eng.max_decode_len, eng.chunk_tokens, False
             )
+            if self.spec:
+                template = jax.jit(eng.bundle.init_spec_fn)(
+                    template, ids, mask
+                )
         empty = jax.tree.map(
             lambda x: np.zeros((self.n_slots,) + tuple(x.shape[1:]), x.dtype),
             template,
         )
+        if self.spec:
+            from ..models.spec import SpecState
+
+            self._hist_w = int(template.history.shape[1])
+            self._kv_w = int(template.base.key_valid.shape[1])
+            empty = SpecState(
+                base=empty.base._replace(
+                    done=np.ones((self.n_slots,), bool)
+                ),
+                history=np.full((self.n_slots, self._hist_w), -1, np.int32),
+            )
+        else:
+            empty = empty._replace(done=np.ones((self.n_slots,), bool))
         # Dead rows: done=True masks every output; other fields are
         # don't-cares until insert overwrites the row.  device_put NOW:
         # leaving numpy leaves here would defer a multi-MB host→device
@@ -544,11 +604,47 @@ class ContinuousDecodeLoop:
         # prefill-state) insert pair would then recompile on the first
         # real admission (measured ~1-8 s through the relay) because
         # warm() only ever saw NamedSharding-carrying states.
-        self._state = jax.device_put(
-            empty._replace(done=np.ones((self.n_slots,), bool)),
-            eng.replicas.batch_sharding,
-        )
+        self._state = jax.device_put(empty, eng.replicas.batch_sharding)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
+
+    def _hist_row(self, feats: dict, first_toks: np.ndarray) -> np.ndarray:
+        """Host-built drafting-history row at the SLOT's width/layout
+        (the single's device history has per-bucket width and, for
+        encoder-decoders, a different decoder offset — padding it would
+        misalign the layout, so the row is rebuilt from what the host
+        already knows: prompt ids + the first chunk's tokens).
+
+        Invariant target (models/spec.py): hist[hoff + p] == the token
+        embedded at cache position p, -1 where no real token lives."""
+        hw, kw = self._hist_w, self._kv_w
+        hoff = hw - kw
+        L = int(feats["length"])
+        ids = np.asarray(feats["input_ids"], np.int32)[:L]
+        row = np.full((1, hw), -1, np.int32)
+        chunk = np.asarray(first_toks, np.int32)
+        if hoff > 0:
+            # Encoder-decoder: [encoder ids | decoder tokens].  Cache
+            # position 0 embedded decoder_start; step-i tokens embed at
+            # position i+1.  The LAST budget token is never embedded,
+            # so clamp when the first chunk already fills the budget
+            # (chunk_tokens == max_decode_len) — the stream finishes
+            # before any lookup could use the clamped tail anyway.
+            row[0, :L] = ids
+            start_id = int(
+                getattr(self.engine.bundle.cfg, "decoder_start_id", 0)
+            )
+            row[0, hoff] = start_id
+            room = hw - (hoff + 1)
+            row[0, hoff + 1 : hoff + 1 + min(chunk.size, room)] = chunk[:room]
+        else:
+            # Decoder-only: prompt at [p_len, p_len+L) (a startup
+            # PROMPT_PREFIX owns [0, p_len) with unknown ids); step-i
+            # tokens embed at cache position p_len + L + i.
+            base = self._p_len + L
+            row[0, self._p_len : base] = ids
+            room = hw - base
+            row[0, base : base + min(chunk.size, room)] = chunk[:room]
+        return row
 
     def _insert_fn(self):
         if self._insert is None:
@@ -556,28 +652,57 @@ class ContinuousDecodeLoop:
             import jax.numpy as jnp
             from jax import lax
 
-            def insert(batched, single, slot, row):
-                def ins(dst, src):
-                    # ``row`` picks ONE row of the (possibly batched)
-                    # prefill state — a wave of admissions prefills as
-                    # one batch and each row lands in its own slot; a
-                    # full-width dynamic_update_slice would clobber the
-                    # adjacent live slots.
-                    src = lax.dynamic_slice_in_dim(src, row, 1, axis=0)
-                    pad = [(0, 0)] + [
-                        (0, int(d) - int(s))
-                        for d, s in zip(dst.shape[1:], src.shape[1:])
-                    ]
-                    srcp = jnp.pad(src.astype(dst.dtype), pad)
-                    start = (slot,) + (0,) * (dst.ndim - 1)
-                    return lax.dynamic_update_slice(dst, srcp, start)
+            def ins_row(dst, src, slot, row):
+                # ``row`` picks ONE row of the (possibly batched)
+                # prefill state — a wave of admissions prefills as
+                # one batch and each row lands in its own slot; a
+                # full-width dynamic_update_slice would clobber the
+                # adjacent live slots.
+                src = lax.dynamic_slice_in_dim(src, row, 1, axis=0)
+                pad = [(0, 0)] + [
+                    (0, int(d) - int(s))
+                    for d, s in zip(dst.shape[1:], src.shape[1:])
+                ]
+                srcp = jnp.pad(src.astype(dst.dtype), pad)
+                start = (slot,) + (0,) * (dst.ndim - 1)
+                return lax.dynamic_update_slice(dst, srcp, start)
 
-                return jax.tree.map(ins, batched, single)
+            if self.spec:
+                bundle = self.engine.bundle
 
-            # NOT donated: in-flight pipelined chunks still reference
-            # buffers of the pre-insert state (their toks/done fetch
-            # later); donation would invalidate them mid-flight.
-            self._insert = jax.jit(insert)
+                def insert_spec(batched, single, ids, mask, hist_row,
+                                slot, row):
+                    # The family's init_spec_fn recasts the prefill
+                    # state to the spec base (adds key_valid/write_idx
+                    # for T5; identity for decoder-only).  Its device-
+                    # built history is DISCARDED: per-bucket widths and
+                    # the encoder-decoder layout offset don't pad to
+                    # the slot shape — the host-built ``hist_row``
+                    # already has the slot's exact layout.
+                    ss = bundle.init_spec_fn(single, ids, mask)
+                    base = jax.tree.map(
+                        lambda d, s: ins_row(d, s, slot, row),
+                        batched.base, ss.base,
+                    )
+                    hist = lax.dynamic_update_slice(
+                        batched.history, hist_row.astype(jnp.int32),
+                        (slot, 0),
+                    )
+                    return type(batched)(base=base, history=hist)
+
+                self._insert = jax.jit(insert_spec)
+            else:
+                def insert(batched, single, slot, row):
+                    return jax.tree.map(
+                        lambda d, s: ins_row(d, s, slot, row),
+                        batched, single,
+                    )
+
+                # NOT donated: in-flight pipelined chunks still
+                # reference buffers of the pre-insert state (their
+                # toks/done fetch later); donation would invalidate
+                # them mid-flight.
+                self._insert = jax.jit(insert)
         return self._insert
 
     # -- decode --------------------------------------------------------
@@ -595,13 +720,25 @@ class ContinuousDecodeLoop:
         eng = self.engine
         use_sample = bool(self.sampled_slots)
         with eng._lock:
-            self._state, toks = eng._gen_chunk(
-                eng.params, self._state, eng.chunk_tokens, use_sample
-            )
-        done = self._state.done
-        # Start the host copies now so the fetch in _deliver_oldest
-        # finds the data (mostly) already on this side of the wire.
-        prefetch_to_host(toks, done)
+            if self.spec:
+                # One batched draft→verify chunk: every live row emits
+                # chunk_tokens..chunk_tokens·(spec_k+1) tokens.
+                self._state, out, ns = eng._spec_chunk(
+                    eng.params, self._state, eng.chunk_tokens,
+                    eng.spec_k, use_sample,
+                )
+                toks = (out, ns)
+                done = self._state.base.done
+                prefetch_to_host(out, ns, done)
+            else:
+                self._state, toks = eng._gen_chunk(
+                    eng.params, self._state, eng.chunk_tokens, use_sample
+                )
+                done = self._state.done
+                # Start the host copies now so the fetch in
+                # _deliver_oldest finds the data (mostly) already on
+                # this side of the wire.
+                prefetch_to_host(toks, done)
         self.chunk_dispatches += 1
         metrics.STREAM_BATCH.labels(eng.bundle.name).observe(len(self.active))
         self._inflight_chunks.append((toks, done, dict(self.active)))
@@ -637,9 +774,27 @@ class ContinuousDecodeLoop:
             if st.cancelled.is_set():
                 self._free_slot(slot)
                 continue
-            st.emit(toks_np[slot])
-            metrics.TOKENS.labels(eng.bundle.name).inc(int(toks_np[slot].size))
-            st.produced += eng.chunk_tokens
+            if self.spec:
+                from ..models.spec import flatten_emitted
+
+                out_np, ns_np = toks_np
+                chunk = flatten_emitted(out_np, ns_np, slot)
+                metrics.SPEC_EMITTED.labels(eng.bundle.name).observe(
+                    int(chunk.size) / max(1, eng.chunk_tokens)
+                )
+                # A verify round can overshoot the budget mid-chunk;
+                # trim so the stream never emits past it.
+                chunk = chunk[: st.budget - st.produced]
+                if chunk.size:
+                    st.emit(chunk)
+                    metrics.TOKENS.labels(eng.bundle.name).inc(int(chunk.size))
+                st.produced += int(chunk.size)
+            else:
+                st.emit(toks_np[slot])
+                metrics.TOKENS.labels(eng.bundle.name).inc(
+                    int(toks_np[slot].size)
+                )
+                st.produced += eng.chunk_tokens
             if bool(done_np[slot]) or st.produced >= st.budget:
                 st.emit(_END)
                 self._free_slot(slot)
@@ -660,6 +815,24 @@ class ContinuousDecodeLoop:
         warm_sampled = _os.environ.get(
             "WARMUP_SAMPLING", "1"
         ).lower() not in ("0", "false", "no")
+
+        def do_insert(state1, ids, mask, s: int):
+            if self.spec:
+                feats0 = {
+                    "input_ids": np.ones(s, np.int32), "length": np.int32(s)
+                }
+                hist_row = self._hist_row(
+                    feats0, np.zeros(eng.chunk_tokens, np.int32)
+                )
+                self._state = self._insert_fn()(
+                    self._state, state1, ids, mask, hist_row,
+                    np.int32(0), np.int32(0),
+                )
+            else:
+                self._state = self._insert_fn()(
+                    self._state, state1, np.int32(0), np.int32(0)
+                )
+
         # Wave sizes to warm: solo (1) and the batched full-wave shape
         # every multi-stream wave pads to (disabled under the prefix
         # cache, whose hits need per-request starts).
@@ -682,15 +855,22 @@ class ContinuousDecodeLoop:
                             eng.params, ids, mask, sp,
                             eng.max_decode_len, eng.chunk_tokens, flag,
                         )
-                        self._state = self._insert_fn()(
-                            self._state, state1, np.int32(0), np.int32(0)
-                        )
-        for flag in (False, True):
+                        do_insert(state1, ids, mask, s)
+        for flag in (False, True) if (warm_sampled or not self.spec) else (
+            False,
+        ):
             with eng._lock:
-                self._state, toks = eng._gen_chunk(
-                    eng.params, self._state, eng.chunk_tokens, flag
-                )
-                jax.device_get(toks)
+                if self.spec:
+                    self._state, out, ns = eng._spec_chunk(
+                        eng.params, self._state, eng.chunk_tokens,
+                        eng.spec_k, flag,
+                    )
+                    jax.device_get(out)
+                else:
+                    self._state, toks = eng._gen_chunk(
+                        eng.params, self._state, eng.chunk_tokens, flag
+                    )
+                    jax.device_get(toks)
         # Re-warm the inserts in SERVING order — against a chunk-OUTPUT
         # batched state.  The first such call in a process pays a
         # ~1-8 s one-time cost through the relay (measured; absent when
@@ -710,9 +890,7 @@ class ContinuousDecodeLoop:
                         eng.params, ids, mask, sp,
                         eng.max_decode_len, eng.chunk_tokens, False,
                     )
-                    self._state = self._insert_fn()(
-                        self._state, state1, np.int32(0), np.int32(0)
-                    )
+                    do_insert(state1, ids, mask, s)
                 jax.block_until_ready(jax.tree.leaves(self._state)[0])
         if self._auto_depth:
             self._tune_chain_depth()
@@ -737,9 +915,15 @@ class ContinuousDecodeLoop:
             with eng._lock:
                 s = self._state
                 for _ in range(k):
-                    s, toks = eng._gen_chunk(
-                        eng.params, s, eng.chunk_tokens, False
-                    )
+                    if self.spec:
+                        s, toks, _ = eng._spec_chunk(
+                            eng.params, s, eng.chunk_tokens, eng.spec_k,
+                            False,
+                        )
+                    else:
+                        s, toks = eng._gen_chunk(
+                            eng.params, s, eng.chunk_tokens, False
+                        )
                 jax.device_get(toks)
             self._state = s
             return _time.perf_counter() - t0

@@ -799,6 +799,18 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
                 "prefixes carry dense bf16 KV that a quantized cache "
                 "cannot absorb (pick one lever per deployment)"
             )
+    if getattr(svc_cfg, "spec_continuous", False):
+        if not getattr(svc_cfg, "spec_decode", None):
+            raise ValueError(
+                "SPEC_CONTINUOUS requires SPEC_DECODE=ngram (it is the "
+                "continuous-loop extension of speculative decoding)"
+            )
+        if getattr(svc_cfg, "prefix_cache", False):
+            raise ValueError(
+                "SPEC_CONTINUOUS and PREFIX_CACHE are mutually exclusive: "
+                "cache hits prefill at per-request shapes the shared "
+                "slot batch cannot hold (pick one lever per deployment)"
+            )
     if getattr(svc_cfg, "prefix_cache", False):
         if not bundle.supports_prefix:
             raise ValueError(

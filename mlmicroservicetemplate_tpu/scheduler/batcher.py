@@ -146,9 +146,21 @@ class Batcher:
             and (self._active_streams + cdl_admitted)
             < int(getattr(self.engine.cfg, "spec_max_streams", 1))
         )
+        # SPEC_CONTINUOUS loop + SPEC_SAMPLED=0: the shared loop would
+        # run rejection-sampling acceptance on sampled rows, violating
+        # the opt-out's strict cross-path seed contract — those streams
+        # bypass to the per-stream chunked path instead (each holds a
+        # worker; the documented cost of the opt-out).
+        sampled_opt_out = (
+            self._cdl is not None
+            and getattr(self._cdl, "spec", False)
+            and not getattr(self.engine, "spec_sampled", True)
+            and float(feats.get("temperature", 0.0)) > 0.0
+        )
         if (
             self._cdl is not None
             and not spec_route
+            and not sampled_opt_out
             and int(feats.get("length", 0)) <= self._cdl.max_prompt
         ):
             return self._cdl.submit_stream(feats)

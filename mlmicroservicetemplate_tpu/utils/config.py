@@ -134,6 +134,19 @@ class ServiceConfig(BaseModel):
     # batched dispatch for all streams beats per-stream speculation
     # under concurrency — speculation is the B=1 latency lever).
     spec_max_streams: int = 1
+    # Speculation inside the continuous-batching loop: the shared slot
+    # state carries a per-row drafting history and the shared chunk
+    # runs draft→verify rounds, so EVERY live stream keeps the
+    # accepted-token multiplier instead of losing drafting beyond
+    # spec_max_streams.  Costs a (spec_k+1)-wide window per row per
+    # round — wins on quoting/repetitive traffic, can lose on
+    # low-acceptance traffic at high width (measure before enabling:
+    # benchmarks/streams_scaling.py prints the spec_continuous column
+    # by default; BENCH_SPEC=0 skips it).  Requires PREFIX_CACHE off
+    # (hit states have per-request shapes the shared slot batch cannot
+    # hold).  With SPEC_SAMPLED=0, sampled streams bypass the loop to
+    # the per-stream chunked path so the strict seed contract holds.
+    spec_continuous: bool = False
     # Rejection-sampling acceptance for temperature>0 requests (accept
     # draft_i with prob p(draft_i) under the filtered distribution;
     # resample the residual on reject): DISTRIBUTION-identical to
@@ -316,6 +329,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("SPEC_SAMPLED")
     if v is not None:
         kwargs["spec_sampled"] = v.lower() not in ("0", "false", "no")
+    v = get("SPEC_CONTINUOUS")
+    if v is not None:
+        kwargs["spec_continuous"] = v.lower() not in ("0", "false", "no")
     v = get("PREFIX_CACHE_MB")
     if v is not None:
         kwargs["prefix_cache_mb"] = float(v)

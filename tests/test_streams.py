@@ -489,3 +489,217 @@ def test_chunk_dispatch_failure_does_not_orphan_wave():
 
     asyncio.run(body())
     cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# SPEC_CONTINUOUS: draft→verify rounds inside the shared slot batch
+
+
+def _spec_cfg(**kw) -> ServiceConfig:
+    kw.setdefault("spec_decode", "ngram")
+    kw.setdefault("spec_continuous", True)
+    kw.setdefault("spec_k", 4)
+    return _cfg(**kw)
+
+
+def test_spec_continuous_token_identity_gpt():
+    """2-8 concurrent greedy streams through the speculative continuous
+    loop emit exactly the non-speculative engine's tokens — and the
+    loop reports speculative emission (>= 1 token per verify round)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_spec import _tiny_gpt_bundle
+
+    bundle = _tiny_gpt_bundle()
+    common = dict(seq_buckets=(32,), max_decode_len=16, max_streams=8,
+                  batch_buckets=(1, 2, 4, 8))
+    cfg = _spec_cfg(**common)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    eng_off = InferenceEngine(
+        bundle, _cfg(**common), ReplicaSet(make_mesh(1))
+    )
+    texts = [
+        "abcababababab", "the quick brown fox", "xyxyxyxyxyxy",
+        "hello", "aaaaabbbbb", "cdcdcdcdcd", "one two three", "zz",
+    ]
+    for n in (2, 8):
+        cdl = ContinuousDecodeLoop(eng, cfg)
+        assert cdl.spec
+        try:
+            feats = [
+                text_feats(bundle.tokenizer, t, 32) for t in texts[:n]
+            ]
+            outs = _run_concurrent(cdl, feats)
+            for f, got, t in zip(feats, outs, texts):
+                ref = _solo_tokens(eng_off, f)
+                m = min(len(got), len(ref))
+                np.testing.assert_array_equal(got[:m], ref[:m], err_msg=t)
+                # A shorter stream must have stopped for a reason: EOS
+                # or the server budget.
+                if len(got) < len(ref):
+                    assert got[-1] == bundle.cfg.eos_id or len(got) >= 16
+        finally:
+            cdl.stop()
+
+
+def test_spec_continuous_token_identity_t5():
+    """Same contract for the encoder-decoder family: slot histories
+    carry [encoder ids | decoder tokens] at the slot layout."""
+    bundle = tiny_t5_bundle()
+    common = dict(seq_buckets=(16, 32), max_decode_len=12, max_streams=4)
+    cfg = _spec_cfg(**common)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    eng_off = InferenceEngine(bundle, _cfg(**common), ReplicaSet(make_mesh(1)))
+    texts = ["the cat sat on the mat the cat", "ab", "hello world hello"]
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    assert cdl.spec
+    try:
+        feats = [text_feats(bundle.tokenizer, t, 32) for t in texts]
+        outs = _run_concurrent(cdl, feats)
+        for f, got, t in zip(feats, outs, texts):
+            ref = _solo_tokens(eng_off, f)
+            m = min(len(got), len(ref))
+            np.testing.assert_array_equal(got[:m], ref[:m], err_msg=t)
+            if len(got) < len(ref):
+                assert got[-1] == bundle.cfg.eos_id or len(got) >= 12
+    finally:
+        cdl.stop()
+
+
+def test_spec_continuous_late_admission_and_budget():
+    """A stream admitted mid-flight into the speculative loop gets its
+    solo tokens; max_tokens trims mid-verify-round overshoot."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_spec import _tiny_gpt_bundle
+
+    bundle = _tiny_gpt_bundle()
+    common = dict(seq_buckets=(32,), max_decode_len=24, max_streams=4,
+                  batch_buckets=(1, 2, 4))
+    cfg = _spec_cfg(**common)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    eng_off = InferenceEngine(bundle, _cfg(**common), ReplicaSet(make_mesh(1)))
+    f_long = text_feats(bundle.tokenizer, "abcabcabcabcabc", 32)
+    f_late = text_feats(bundle.tokenizer, "late stream", 32)
+    f_cap = dict(text_feats(bundle.tokenizer, "xyxyxyxy", 32), max_tokens=5)
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    async def body():
+        t1 = asyncio.ensure_future(_consume(cdl, dict(f_long)))
+        await asyncio.sleep(0.5)
+        t2 = asyncio.ensure_future(_consume(cdl, dict(f_late)))
+        t3 = asyncio.ensure_future(_consume(cdl, dict(f_cap)))
+        return await asyncio.gather(t1, t2, t3)
+
+    try:
+        got_long, got_late, got_cap = asyncio.run(body())
+        for got, f in ((got_long, f_long), (got_late, f_late)):
+            ref = _solo_tokens(eng_off, f)
+            m = min(len(got), len(ref))
+            np.testing.assert_array_equal(got[:m], ref[:m])
+        assert len(got_cap) <= 5 + eng.chunk_tokens  # first chunk + trim
+    finally:
+        cdl.stop()
+
+
+def test_spec_continuous_sampled_deterministic():
+    """A seeded sampled stream through the speculative loop reproduces
+    its tokens regardless of batch composition (solo vs concurrent)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_spec import _tiny_gpt_bundle
+
+    bundle = _tiny_gpt_bundle()
+    common = dict(seq_buckets=(32,), max_decode_len=16, max_streams=4,
+                  batch_buckets=(1, 2, 4))
+    cfg = _spec_cfg(**common)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    f_s = dict(
+        text_feats(bundle.tokenizer, "abcababab", 32),
+        temperature=1.0, seed=13,
+    )
+    f_g = text_feats(bundle.tokenizer, "greedy neighbor", 32)
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        solo = _run_concurrent(cdl, [f_s])[0]
+    finally:
+        cdl.stop()
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        outs = _run_concurrent(cdl, [f_s, f_g])
+    finally:
+        cdl.stop()
+    np.testing.assert_array_equal(solo, outs[0])
+
+
+def test_spec_continuous_sampled_opt_out_routes_around_loop():
+    """SPEC_CONTINUOUS + SPEC_SAMPLED=0: sampled streams bypass the
+    speculative loop (strict seed contract) and match the plain
+    engine's seeded output exactly; greedy streams still use it."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_spec import _tiny_gpt_bundle
+
+    from mlmicroservicetemplate_tpu.scheduler import Batcher
+
+    bundle = _tiny_gpt_bundle()
+    common = dict(seq_buckets=(32,), max_decode_len=12, max_streams=4,
+                  batch_buckets=(1, 2, 4), batch_timeout_ms=1.0)
+    cfg = _spec_cfg(spec_sampled=False, spec_max_streams=0, **common)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    eng_off = InferenceEngine(bundle, _cfg(**common), ReplicaSet(make_mesh(1)))
+    batcher = Batcher(eng, cfg)
+    f_s = dict(
+        text_feats(bundle.tokenizer, "ababab", 32), temperature=1.0, seed=9
+    )
+    ref = _solo_tokens(eng_off, f_s)
+
+    async def body():
+        got = await _collect(batcher.submit_stream(dict(f_s)))
+        # Bypassed the loop entirely (no loop prefill)...
+        assert batcher._cdl.prefill_dispatches == 0
+        # ...and the greedy stream DOES use the speculative loop.
+        await _collect(batcher.submit_stream(
+            text_feats(bundle.tokenizer, "greedy", 32)
+        ))
+        assert batcher._cdl.prefill_dispatches == 1
+        await batcher.stop()
+        return got
+
+    got = asyncio.run(body())
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_spec_continuous_hist_row_full_budget_chunk():
+    """chunk_tokens == max_decode_len: the first chunk fills the whole
+    decoder history region; _hist_row must clamp, not crash (T5's
+    decoder region is exactly max_decode_len wide)."""
+    bundle = tiny_t5_bundle()
+    cfg = _spec_cfg(
+        seq_buckets=(16,), max_decode_len=8, stream_chunk_tokens=8,
+        max_streams=2,
+    )
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        cdl._build_empty_state()
+        feats = text_feats(bundle.tokenizer, "abc", 32)
+        row = cdl._hist_row(feats, np.arange(1, 9, dtype=np.int32))
+        hoff = cdl._hist_w - cdl._kv_w
+        # decoder region: start id + the first 7 chunk tokens (the 8th
+        # would land past the region; the stream is finished anyway).
+        assert row[0, hoff] == bundle.cfg.decoder_start_id
+        np.testing.assert_array_equal(
+            row[0, hoff + 1 :], np.arange(1, 8, dtype=np.int32)
+        )
+    finally:
+        cdl.stop()
