@@ -242,6 +242,30 @@ class InferenceEngine:
                 self._start_prefixed = jax.jit(
                     start_prefixed, static_argnums=(5, 6, 7)
                 )
+
+                # Batched-wave variant: N same-(prefix-bucket,
+                # suffix-bucket) cache hits prefill as ONE dispatch.
+                # Each row's pkv rides in a tuple and stacks inside the
+                # trace; the models' prefix broadcast is an identity
+                # when the stacked batch dim equals the batch, so every
+                # row attends to ITS OWN prefix.  One executable per
+                # (prefix, suffix) pair and tuple length.
+                def start_prefixed_wave(p, pkvs, ids, mask, sp,
+                                        max_len: int, n_steps: int,
+                                        sample: bool):
+                    import jax.numpy as jnp
+
+                    pkv = jax.tree.map(
+                        lambda *xs: jnp.concatenate(xs, axis=0), *pkvs
+                    )
+                    p2 = dict(p, __prefix__=pkv)
+                    enc = bundle.encode_fn(p2, ids, mask)
+                    state = bundle.init_state_fn(p2, enc, mask, max_len, sample=sp)
+                    return bundle.generate_chunk_fn(p2, state, n_steps, sample)
+
+                self._start_prefixed_wave = jax.jit(
+                    start_prefixed_wave, static_argnums=(5, 6, 7)
+                )
                 self._slice_prefix: dict[int, Any] = {}
 
                 # SPEC_DECODE × PREFIX_CACHE composition: the greedy
@@ -506,21 +530,31 @@ class InferenceEngine:
                 )
         return state, toks, sampled
 
-    def _capture_prefix(self, state, p_len: int):
-        """Prefix KV from a fresh prefill's cache rows [0, p_len) —
-        one jitted slice dispatch, shaped like compute_prefix_kv's
-        pytree so ``__prefix__`` consumers take it unchanged."""
+    def _capture_prefix(self, state, p_len: int, row: int = 0):
+        """Prefix KV from a fresh prefill's cache rows [0, p_len) of
+        batch row ``row`` (traced — one executable per p_len even when
+        donating from a batched wave state) — one jitted slice
+        dispatch, shaped like compute_prefix_kv's pytree so
+        ``__prefix__`` consumers take it unchanged."""
         import jax
 
         if p_len not in self._slice_prefix:
-            def slc(st):
+            from jax import lax
+
+            def slc(st, r):
                 return {
-                    "k": [c[:1, :p_len] for c in st.cache_k],
-                    "v": [c[:1, :p_len] for c in st.cache_v],
+                    "k": [
+                        lax.dynamic_slice_in_dim(c, r, 1, axis=0)[:, :p_len]
+                        for c in st.cache_k
+                    ],
+                    "v": [
+                        lax.dynamic_slice_in_dim(c, r, 1, axis=0)[:, :p_len]
+                        for c in st.cache_v
+                    ],
                 }
 
             self._slice_prefix[p_len] = jax.jit(slc)
-        return self._slice_prefix[p_len](state)
+        return self._slice_prefix[p_len](state, np.int32(row))
 
     def generate_stream(self, feats: dict) -> Iterator[np.ndarray]:
         """Streaming seq2seq for one request: yields int32 token chunks

@@ -424,10 +424,11 @@ class ContinuousDecodeLoop:
         A multi-stream wave prefills as ONE batched ``_start`` dispatch
         (rows padded to the widest prompt bucket in the wave): through
         a relay where each dispatch costs real wire time, a wave pays
-        one dispatch + one fetch TOTAL, not per stream.  Waves fall
-        back to per-stream starts when the per-request prefix cache is
-        on (hits need per-request shapes) or the wave is a single
-        stream."""
+        one dispatch + one fetch TOTAL, not per stream.  Under the
+        per-request prefix cache, waves group by (prefix, suffix)
+        bucket instead — one batched prefixed start per hit group, one
+        shared full-prefill wave for the misses
+        (``_admit_prefixed_locked``)."""
         eng = self.engine
         started: list[tuple] = []  # (st, state1, toks, sampled, row, ids, mask)
         ok: list[_Stream] = []
@@ -447,7 +448,14 @@ class ContinuousDecodeLoop:
         if not ok:
             return started
         with eng._lock:
-            if (len(ok) == 1 or eng.prefix_cache is not None) and not self.spec:
+            if eng.prefix_cache is not None and len(ok) > 1:
+                # Grouped wave admission under the per-request prefix
+                # cache: same-(prefix, suffix)-bucket hits batch into
+                # one prefixed start each, misses share one full
+                # prefill wave — a burst of N same-prefix chat
+                # requests pays ~1 prefill dispatch, not N.
+                return self._admit_prefixed_locked(ok)
+            if len(ok) == 1 and not self.spec:
                 for st in ok:
                     try:
                         # Fused prefill+first-chunk at the request's
@@ -494,6 +502,127 @@ class ContinuousDecodeLoop:
                 # the per-step [B, V] sort.
                 row_sampled = float(st.feats.get("temperature", 0.0)) > 0.0
                 started.append((st, state1, toks, row_sampled, row, ids, mask))
+        return started
+
+    def _admit_prefixed_locked(self, ok: list[_Stream]) -> list:
+        """Wave admission with the per-request prefix cache on (caller
+        holds ``eng._lock``).  Each stream is matched ONCE (here —
+        never re-matched downstream, keeping hit/miss stats and LRU
+        recency exact): hits group by (prefix-bucket, suffix-bucket)
+        and prefill as ONE ``_start_prefixed_wave`` dispatch per group
+        (each row's cached KV stacks inside the trace; solo hits use
+        the B=1 ``_start_prefixed``); misses share ONE full-prefill
+        wave (solo misses at B=1) and donate their prefixes per row."""
+        from .engine import bucket_for
+
+        eng = self.engine
+        started: list[tuple] = []
+        groups: dict[tuple[int, int], list] = {}
+        misses: list[tuple[_Stream, np.ndarray, int]] = []
+        for st in ok:
+            L = int(st.feats["length"])
+            row_ids = np.asarray(st.feats["input_ids"], np.int32)[:L]
+            m = eng.prefix_cache.match(
+                row_ids, L, usable=eng._prefix_guard(L)
+            )
+            if m is None:
+                misses.append((st, row_ids, L))
+                continue
+            p_len, pkv = m
+            s_suf = bucket_for(
+                max(L - p_len, 1), eng.seq_buckets,
+                eng.replicas.seq_multiple(),
+            )
+            groups.setdefault((p_len, s_suf), []).append(
+                (st, row_ids, L, p_len, pkv)
+            )
+
+        def collate_place(feats_list):
+            ids, mask, _ = eng._collate_text(feats_list)
+            sp, sampled = eng._collate_sample(feats_list, ids.shape[0])
+            ids, mask = eng.replicas.place_batch(ids, mask)
+            return ids, mask, sp, sampled
+
+        def pad_feats(feats_list):
+            pad_to = 1 if len(feats_list) == 1 else self.n_slots
+            return feats_list + [
+                {"input_ids": np.zeros(0, np.int32), "length": np.int32(0)}
+            ] * (pad_to - len(feats_list))
+
+        def record(state1, toks, streams):
+            self.prefill_dispatches += 1
+            prefetch_to_host(toks, state1.done)
+            for row, st in enumerate(streams):
+                row_sampled = float(st.feats.get("temperature", 0.0)) > 0.0
+                started.append(
+                    (st, state1, toks, row_sampled, row, None, None)
+                )
+
+        def donate(state1, row, row_ids, L, min_over: int | None):
+            """Per-row prefix donation; ``min_over`` = only donate
+            buckets strictly larger (the hit path's growing-
+            conversation rule), None = any (miss path)."""
+            p_ins = eng.prefix_cache.bucket_for_insert(L)
+            if (
+                p_ins is not None
+                and (min_over is None or p_ins > min_over)
+                and not eng.prefix_cache.contains(row_ids, p_ins)
+            ):
+                eng.prefix_cache.insert(
+                    row_ids, p_ins, eng._capture_prefix(state1, p_ins, row)
+                )
+
+        if misses:
+            try:
+                ids, mask, sp, sampled = collate_place(
+                    pad_feats([st.feats for st, _, _ in misses])
+                )
+                state1, toks = eng._start(
+                    eng.params, ids, mask, sp,
+                    eng.max_decode_len, eng.chunk_tokens, sampled,
+                )
+            except Exception as e:
+                for st, _, _ in misses:
+                    self._finish(st, e)
+            else:
+                for row, (st, row_ids, L) in enumerate(misses):
+                    donate(state1, row, row_ids, L, None)
+                record(state1, toks, [st for st, _, _ in misses])
+
+        # Hit groups: one batched prefixed start per (prefix, suffix)
+        # bucket pair; multi-member groups pad to the slot count so
+        # every group size shares the pair's ONE executable.
+        for (p_len, s_suf), members in groups.items():
+            suffix_feats = [
+                dict(st.feats, input_ids=row_ids[p_len:],
+                     length=np.int32(L - p_len))
+                for st, row_ids, L, _, _ in members
+            ]
+            try:
+                ids, mask, sp, sampled = collate_place(
+                    pad_feats(suffix_feats)
+                )
+                if len(members) == 1:
+                    state1, toks = eng._start_prefixed(
+                        eng.params, members[0][4], ids, mask, sp,
+                        eng.max_decode_len, eng.chunk_tokens, sampled,
+                    )
+                else:
+                    pkvs = tuple(pkv for _, _, _, _, pkv in members)
+                    pkvs = pkvs + (pkvs[0],) * (ids.shape[0] - len(pkvs))
+                    state1, toks = eng._start_prefixed_wave(
+                        eng.params, pkvs, ids, mask, sp,
+                        eng.max_decode_len, eng.chunk_tokens, sampled,
+                    )
+            except Exception as e:
+                for st, *_ in members:
+                    self._finish(st, e)
+                continue
+            for row, (st, row_ids, L, pl, _) in enumerate(members):
+                # Growing conversations keep donating from the hit path
+                # (start_fused's rule, applied per row).
+                donate(state1, row, row_ids, L, pl)
+            record(state1, toks, [st for st, *_ in members])
         return started
 
     def _admit_complete(self, started: list) -> None:
@@ -834,10 +963,11 @@ class ContinuousDecodeLoop:
                 )
 
         # Wave sizes to warm: solo (1) and the batched full-wave shape
-        # every multi-stream wave pads to (disabled under the prefix
-        # cache, whose hits need per-request starts).
+        # every multi-stream wave pads to.  Under the prefix cache the
+        # full wave still serves grouped MISSES (hits go through the
+        # grouped prefixed waves warmed below).
         wave_sizes = [1]
-        if eng.prefix_cache is None and self.n_slots > 1:
+        if self.n_slots > 1:
             wave_sizes.append(self.n_slots)
         for s in eng.seq_buckets:
             for n_batch in wave_sizes:
@@ -891,7 +1021,95 @@ class ContinuousDecodeLoop:
                         eng.max_decode_len, eng.chunk_tokens, False,
                     )
                     do_insert(state1, ids, mask, s)
+                    # Miss-wave donation slicers specialize on the
+                    # batched state shape — warm them here so the first
+                    # grouped miss wave never compiles a capture on the
+                    # request path.
+                    if eng.prefix_cache is not None and n_batch > 1:
+                        for p_ins in eng.seq_buckets:
+                            if p_ins <= s:
+                                eng._capture_prefix(state1, p_ins, 0)
                 jax.block_until_ready(jax.tree.leaves(self._state)[0])
+        # Prefix-cache grid: a cache hit's state has width
+        # p_len+s_suf+max_decode — a shape none of the inserts above
+        # ever saw, so the FIRST hit admission would otherwise compile
+        # the insert on the request path (~1-8 s through the relay).
+        # Warm the insert against B=1 hit states AND the grouped
+        # (_start_prefixed_wave) states per reachable (prefix, suffix)
+        # pair, plus the wave executables themselves and their hit-path
+        # donation slicers.  (The B=1 starts run sample=False only:
+        # engine.warmup already compiled both sample variants of
+        # _start_prefixed, and the INSERT executable this block exists
+        # for is sample-agnostic — state shapes don't depend on it.)
+        if eng.prefix_cache is not None:
+            s_max = max(eng.seq_buckets)
+            feats_max = {
+                "input_ids": np.ones(s_max, np.int32),
+                "length": np.int32(s_max),
+            }
+            with eng._lock:
+                ids, mask, _ = eng._collate_text([feats_max])
+                sp, _ = eng._collate_sample([feats_max], ids.shape[0])
+                ids, mask = eng.replicas.place_batch(ids, mask)
+                template, _ = eng._start(
+                    eng.params, ids, mask, sp,
+                    eng.max_decode_len, eng.chunk_tokens, False,
+                )
+            for p_len in eng.seq_buckets:
+                if p_len > s_max - 1:
+                    continue
+                with eng._lock:
+                    pkv = eng._capture_prefix(template, p_len)
+                for s_suf in eng.seq_buckets:
+                    if p_len + s_suf > s_max:
+                        continue
+                    sfeats = {
+                        "input_ids": np.ones(s_suf, np.int32),
+                        "length": np.int32(s_suf),
+                    }
+                    with eng._lock:
+                        sids, smask, _ = eng._collate_text([sfeats])
+                        ssp, _ = eng._collate_sample([sfeats], sids.shape[0])
+                        sids, smask = eng.replicas.place_batch(sids, smask)
+                        st1, _ = eng._start_prefixed(
+                            eng.params, pkv, sids, smask, ssp,
+                            eng.max_decode_len, eng.chunk_tokens, False,
+                        )
+                        self._state = self._insert_fn()(
+                            self._state, st1, np.int32(0), np.int32(0)
+                        )
+                    if self.n_slots > 1:
+                        wfeats = [sfeats] * self.n_slots
+                        with eng._lock:
+                            wids, wmask, _ = eng._collate_text(wfeats)
+                            wsp, _ = eng._collate_sample(
+                                wfeats, wids.shape[0]
+                            )
+                            wids, wmask = eng.replicas.place_batch(
+                                wids, wmask
+                            )
+                            pkvs = (pkv,) * wids.shape[0]
+                            for flag in (
+                                (False, True) if warm_sampled else (False,)
+                            ):
+                                stw, tw = eng._start_prefixed_wave(
+                                    eng.params, pkvs, wids, wmask, wsp,
+                                    eng.max_decode_len, eng.chunk_tokens,
+                                    flag,
+                                )
+                                jax.device_get(tw)
+                            self._state = self._insert_fn()(
+                                self._state, stw, np.int32(0), np.int32(0)
+                            )
+                            # Wave-state donation slicers (growing
+                            # conversations donate per row from the
+                            # grouped hit state).
+                            for p_ins in eng.seq_buckets:
+                                if p_len < p_ins <= p_len + s_suf - 1:
+                                    eng._capture_prefix(stw, p_ins, 0)
+                    jax.block_until_ready(
+                        jax.tree.leaves(self._state)[0]
+                    )
         if self._auto_depth:
             self._tune_chain_depth()
         # Reset to all-dead so warm inserts never leak into serving.

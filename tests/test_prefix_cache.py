@@ -189,3 +189,80 @@ def test_growing_conversation_keeps_donating():
     eng_off, _, _ = _engine(False)
     off3 = np.concatenate(list(eng_off.generate_stream(_feats(None, turn3))))
     np.testing.assert_array_equal(out3, off3)
+
+
+def test_grouped_wave_prefill_under_prefix_cache():
+    """A burst of N same-prefix streams admits as ONE grouped prefixed
+    wave (1 prefill dispatch, not N), token-identical to solo serving;
+    a mixed hit/miss burst pays one dispatch per group."""
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+
+    eng, bundle, cfg = _engine(True, max_streams=4)
+    rng = np.random.default_rng(3)
+    shared = rng.integers(5, 250, 20).astype(np.int32)  # covers bucket 16
+    prompts = [
+        np.concatenate([shared, rng.integers(5, 250, n).astype(np.int32)])
+        for n in (4, 7, 11)
+    ]
+    solo = [
+        np.concatenate(list(eng.generate_stream(_feats(None, p))))
+        for p in prompts
+    ]
+    assert eng.prefix_cache.stats()["entries"] >= 1
+
+    async def collect(gen):
+        out = []
+        async for c in gen:
+            out.append(np.asarray(c))
+        return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    async def body():
+        gens = [cdl.submit_stream(_feats(None, p)) for p in prompts]
+        return await asyncio.gather(*[collect(g) for g in gens])
+
+    try:
+        outs = asyncio.run(body())
+        # All three hit the same (prefix=16, suffix=16) group: ONE
+        # grouped prefill dispatch served the whole wave (racy wave
+        # formation may split it, never exceed the stream count).
+        assert 1 <= cdl.prefill_dispatches <= 3, cdl.prefill_dispatches
+        for got, want in zip(outs, solo):
+            n = min(len(got), len(want))
+            np.testing.assert_array_equal(got[:n], want[:n])
+    finally:
+        cdl.stop()
+
+    # Mixed burst: two hits + two REAL misses (a prefix the cache has
+    # never seen — the wave runs first, solo references after) — the
+    # hits group into one prefixed wave, the misses share one full
+    # prefill wave and donate their prefix.
+    fresh = rng.integers(5, 250, 20).astype(np.int32)
+    mixed = [
+        np.concatenate([shared, rng.integers(5, 250, 5).astype(np.int32)]),
+        np.concatenate([shared, rng.integers(5, 250, 9).astype(np.int32)]),
+        np.concatenate([fresh, rng.integers(5, 250, 5).astype(np.int32)]),
+        np.concatenate([fresh, rng.integers(5, 250, 9).astype(np.int32)]),
+    ]
+    assert not eng.prefix_cache.contains(fresh, 16)
+    cdl = ContinuousDecodeLoop(eng, cfg)
+
+    async def body2():
+        gens = [cdl.submit_stream(_feats(None, p)) for p in mixed]
+        return await asyncio.gather(*[collect(g) for g in gens])
+
+    try:
+        outs = asyncio.run(body2())
+        # Miss rows donated from the batched wave state (per-row
+        # capture): the fresh prefix is now cached.
+        assert eng.prefix_cache.contains(fresh, 16)
+    finally:
+        cdl.stop()
+    solo_mixed = [
+        np.concatenate(list(eng.generate_stream(_feats(None, p))))
+        for p in mixed
+    ]
+    for got, want in zip(outs, solo_mixed):
+        n = min(len(got), len(want))
+        np.testing.assert_array_equal(got[:n], want[:n])
