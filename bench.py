@@ -162,7 +162,47 @@ def bench_device_side(engine) -> dict:
         return {}
 
 
+def bench_relay_weather() -> dict:
+    """Session weather report: dispatch round-trip + device→host wire
+    bandwidth, measured up front and attached to the headline JSON —
+    end-to-end req/s on this relay-attached box swings ~2× between
+    sessions with these two numbers, so every recorded figure should
+    carry its own conditions."""
+    try:
+        import numpy as np
+
+        import jax
+
+        dev = jax.devices()[0]
+        small = jax.device_put(np.zeros((8,), np.float32), dev)
+        jax.block_until_ready(small)
+        jax.device_get(small)  # prime
+        n = 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jax.device_get(small)
+        rtt = (time.perf_counter() - t0) / n
+        big = jax.device_put(np.zeros((4 * 1024 * 1024,), np.float32), dev)
+        jax.block_until_ready(big)
+        jax.device_get(big)  # prime
+        t0 = time.perf_counter()
+        jax.device_get(big)
+        dt = time.perf_counter() - t0
+        return {
+            "relay_rtt_ms": round(rtt * 1e3, 1),
+            "wire_mb_s": round(
+                (big.nbytes / 1e6) / max(dt - rtt, 1e-6), 1
+            ),
+        }
+    except Exception as e:  # never sink the headline on a weather probe
+        print(f"relay weather probe failed: {e}", file=sys.stderr)
+        return {}
+
+
 def main() -> None:
+    weather = bench_relay_weather()
+    if weather:
+        print(json.dumps({"relay_weather": weather}), file=sys.stderr)
     serving, engine = asyncio.run(bench_serving())
     device = bench_device_side(engine)
     torch_rps = bench_torch_cpu()
@@ -175,6 +215,7 @@ def main() -> None:
         ),
         **serving,
         **device,
+        **weather,
         "torch_cpu_req_s": round(torch_rps, 3) if torch_rps else None,
     }
     print(json.dumps(result))

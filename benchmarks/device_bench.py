@@ -33,7 +33,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCAN_ITERS = int(os.environ.get("SCAN_ITERS", "16"))
 PIPELINE_BATCHES = int(os.environ.get("PIPELINE_BATCHES", "24"))
-RESNET50_ANALYTIC_FLOPS = 4.09e9  # fwd FLOPs per 224x224 image (2xMAC)
+# Forward FLOPs per 224x224 image.  The canonical "4.1 GFLOPs"
+# ResNet-50 figure counts multiply-accumulates as ONE op; in the
+# 2-ops-per-MAC convention every MFU definition uses (peak TFLOP/s
+# counts multiplies AND adds), the forward is ~8.2e9.  Three
+# independent sources agree: XLA cost analysis reports 7.9e9, a
+# per-layer analytic count over the v1.5 graph gives 8.18e9
+# (benchmarks/resnet_profile.py), and 2 x 4.09 GMACs = 8.18e9.
+# Rounds 2-4 used 4.09e9 here (the MAC count mislabeled as FLOPs),
+# halving every reported ResNet MFU — the "28%" plateau was an
+# accounting artifact, not a hardware ceiling.
+RESNET50_ANALYTIC_FLOPS = 8.18e9
 
 
 def measure_rtt(reps: int = 5) -> float:
@@ -137,10 +147,11 @@ def bench_device(engine, batch: int = 32) -> dict:
     device_img_s = batch / device_batch_s
 
     xla_flops = flops_per_image(forward, params, images)
-    # XLA's cost analysis reports ~2x the conventional ResNet-50 count
-    # (7.9 vs 4.09 GFLOP/img, same on CPU and TPU).  Use the LOWER,
-    # community-standard figure for the headline MFU so it cannot be
-    # accused of flattery; the XLA number ships alongside.
+    # Headline MFU uses the LOWER of XLA's cost analysis (7.9e9/img)
+    # and the analytic 2-ops-per-MAC count (8.18e9) — both in the same
+    # convention as the 197 TFLOP/s peak, so the ratio is honest.
+    # (Rounds 2-4 divided by the 4.09e9 MAC count instead, reporting
+    # half the real utilization; see RESNET50_ANALYTIC_FLOPS.)
     flops = (
         min(xla_flops, RESNET50_ANALYTIC_FLOPS)
         if bundle.name.startswith("resnet")

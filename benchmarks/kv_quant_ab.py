@@ -31,7 +31,7 @@ CONTEXTS = tuple(
 )
 
 
-def step_ms(kv_quant: bool, s_len: int) -> tuple[float, bool]:
+def step_ms(kv_quant: bool, s_len: int, pallas: bool = False) -> tuple[float, bool]:
     import jax
 
     from timing import chunked_time_per_step
@@ -41,6 +41,10 @@ def step_ms(kv_quant: bool, s_len: int) -> tuple[float, bool]:
     from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
     from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
 
+    if pallas:
+        os.environ["USE_PALLAS_DECODE"] = "1"
+    else:
+        os.environ.pop("USE_PALLAS_DECODE", None)
     cfg = ServiceConfig(
         device=os.environ.get("DEVICE", "tpu"),
         model_name=os.environ.get("MODEL_NAME", "llama"),
@@ -82,17 +86,37 @@ def main() -> None:
 
     apply_device_env(ServiceConfig(device=os.environ.get("DEVICE", "tpu")))
     rows = []
+    # Pallas decode-attention columns (VERDICT r4 next #5): in-kernel
+    # int8 dequant tests the hypothesis behind the measured XLA
+    # kv-quant loss, and the dense kernel removes the GQA repeat.
+    # KV_PALLAS=0 skips them.
+    do_pallas = os.environ.get("KV_PALLAS", "1").lower() not in (
+        "0", "false", "no"
+    )
     for s_len in CONTEXTS:
         dense_ms, n1 = step_ms(False, s_len)
         q_ms, n2 = step_ms(True, s_len)
-        rows.append({
+        row = {
             "context": s_len,
             "batch": BATCH,
             "dense_kv_step_ms": round(dense_ms, 3),
             "int8_kv_step_ms": round(q_ms, 3),
             "timing_noisy": bool(n1 or n2),
             "speedup": round(dense_ms / max(q_ms, 1e-9), 3),
-        })
+        }
+        if do_pallas:
+            pd_ms, n3 = step_ms(False, s_len, pallas=True)
+            pq_ms, n4 = step_ms(True, s_len, pallas=True)
+            row.update({
+                "dense_pallas_step_ms": round(pd_ms, 3),
+                "int8_pallas_step_ms": round(pq_ms, 3),
+                "pallas_dense_speedup": round(dense_ms / max(pd_ms, 1e-9), 3),
+                "pallas_int8_vs_dense_xla": round(
+                    dense_ms / max(pq_ms, 1e-9), 3
+                ),
+                "timing_noisy_pallas": bool(n3 or n4),
+            })
+        rows.append(row)
         print(json.dumps(rows[-1]), flush=True)
     print(json.dumps({
         "model": os.environ.get("MODEL_NAME", "llama"),

@@ -300,6 +300,33 @@ def _apply_stop(text: str, stops) -> str:
     return text[:cut]
 
 
+def _tokens_covering(decode, tokens, target_len: int) -> int:
+    """Smallest n with len(decode(tokens[:n])) >= target_len — binary
+    search plus a local walk-down, replacing the O(n^2) linear recount
+    on the stop-string path (each decode is O(n); long generations with
+    stop strings paid the square).
+
+    Decoded length is monotone in the token count EXCEPT locally at
+    multi-byte UTF-8 splits (a dangling prefix renders as replacement
+    chars that a later byte can merge), so the bisection alone could
+    land one token off the true minimum; the walk-down restores the
+    smallest covering n through any such plateau.  Returns len(tokens)
+    when even the full decode falls short (a truncated trailing byte
+    sequence can decode shorter than the text it was cut from)."""
+    if len(decode(tokens)) < target_len:
+        return len(tokens)
+    lo, hi = 0, len(tokens)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if len(decode(tokens[:mid])) >= target_len:
+            hi = mid
+        else:
+            lo = mid + 1
+    while lo > 0 and len(decode(tokens[: lo - 1])) >= target_len:
+        lo -= 1
+    return lo
+
+
 def _stop_holdback(text: str, stops) -> int:
     """Chars to withhold from streaming: the longest suffix of ``text``
     that is a strict prefix of some stop string — it may complete into
@@ -353,10 +380,7 @@ async def _delta_stream(bundle: ModelBundle, stream_iter, item: RawItem):
                 text, finished, reason = stopped, True, "stop"
                 # tokens must not count past the truncation: keep the
                 # smallest count whose decode covers the final text.
-                for n in range(len(tokens) + 1):
-                    if len(decode(tokens[:n])) >= len(text):
-                        tokens = tokens[:n]
-                        break
+                tokens = tokens[: _tokens_covering(decode, tokens, len(text))]
             elif not finished:
                 # Withhold any suffix that could complete into a stop
                 # string next chunk.  (A "stop" inside already-emitted
@@ -483,13 +507,13 @@ async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
                 # Token count must not run past the truncation (same
                 # rule as _delta_stream): smallest count whose decode
                 # covers the final text.
-                row_list = [int(t) for t in np.asarray(row).tolist()]
-                for n in range(n_tok + 1):
-                    if len(bundle.tokenizer.decode(
-                        np.array(row_list[:n], np.int32)
-                    )) >= len(cut):
-                        n_tok = n
-                        break
+                row_list = [int(t) for t in np.asarray(row).tolist()][:n_tok]
+                n_tok = _tokens_covering(
+                    lambda ts: bundle.tokenizer.decode(
+                        np.array(ts, np.int32)
+                    ),
+                    row_list, len(cut),
+                )
             text = cut
         finish = "stop" if (
             stopped_by_string
@@ -510,7 +534,7 @@ async def _openai_prologue(request: web.Request, to_prompt):
     """Shared /v1 prologue: seq2seq gate, JSON parse, prompt derivation
     (``to_prompt(body) -> str`` — ValueError = client 400, LookupError =
     server-config 500), field translation onto /predict's validator,
-    preprocess.  Returns (app, bundle, item, feats, t0)."""
+    preprocess.  Returns (app, bundle, item, feats, t0, include_usage)."""
     app = request.app
     bundle: ModelBundle = app[K_BUNDLE]
     if bundle.kind != KIND_SEQ2SEQ:
@@ -570,7 +594,15 @@ async def _openai_prologue(request: web.Request, to_prompt):
     except (ValueError, OSError) as e:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=str(e) or "bad request")
-    return app, bundle, item, feats, t0
+    # OpenAI stream semantics: usage appears in a stream ONLY when the
+    # client asked via stream_options.include_usage (then every chunk
+    # carries "usage": null and one extra final chunk carries the
+    # numbers) — an unsolicited usage chunk is a protocol deviation to
+    # strict clients.  Non-stream responses always include usage.
+    include_usage = bool(
+        (body.get("stream_options") or {}).get("include_usage", False)
+    )
+    return app, bundle, item, feats, t0, include_usage
 
 
 def _sse_frame(payload: dict) -> bytes:
@@ -630,24 +662,34 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
             raise ValueError('"prompt" must be a non-empty string')
         return prompt
 
-    app, bundle, item, feats, t0 = await _openai_prologue(request, to_prompt)
+    app, bundle, item, feats, t0, include_usage = await _openai_prologue(
+        request, to_prompt
+    )
 
     if item.stream:
+        def frame(text, finish) -> dict:
+            payload = {
+                "object": "text_completion", "model": bundle.name,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish}],
+            }
+            if include_usage:
+                payload["usage"] = None
+            return payload
+
         def events(ev):
             if "delta" in ev:
                 if not ev["delta"]:
                     return []
-                return [_sse_frame({
+                return [_sse_frame(frame(ev["delta"], None))]
+            frames = [_sse_frame(frame("", ev["finish_reason"]))]
+            if include_usage:
+                frames.append(_sse_frame({
                     "object": "text_completion", "model": bundle.name,
-                    "choices": [{"index": 0, "text": ev["delta"],
-                                 "finish_reason": None}],
-                })]
-            return [_sse_frame({
-                "object": "text_completion", "model": bundle.name,
-                "choices": [{"index": 0, "text": "",
-                             "finish_reason": ev["finish_reason"]}],
-                "usage": _usage(feats, ev["tokens"]),
-            })]
+                    "choices": [],
+                    "usage": _usage(feats, ev["tokens"]),
+                }))
+            return frames
 
         return await _sse_stream(request, feats, item, t0, events)
 
@@ -687,25 +729,32 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
     prompt (CHAT_TEMPLATE) and serve it through the SAME path as
     /v1/completions, answering in the chat response shapes."""
     tmpl = request.app[K_STATE].get("chat_template")
-    app, bundle, item, feats, t0 = await _openai_prologue(
+    app, bundle, item, feats, t0, include_usage = await _openai_prologue(
         request, lambda body: _render_chat(body.get("messages"), tmpl)
     )
 
     if item.stream:
-        def chunk(delta: dict, finish, usage: dict | None = None) -> bytes:
+        def chunk(delta: dict, finish) -> bytes:
             payload = {
                 "object": "chat.completion.chunk", "model": bundle.name,
                 "choices": [{"index": 0, "delta": delta,
                              "finish_reason": finish}],
             }
-            if usage is not None:
-                payload["usage"] = usage
+            if include_usage:
+                payload["usage"] = None
             return _sse_frame(payload)
 
         def events(ev):
             if "delta" in ev:
                 return [chunk({"content": ev["delta"]}, None)] if ev["delta"] else []
-            return [chunk({}, ev["finish_reason"], _usage(feats, ev["tokens"]))]
+            frames = [chunk({}, ev["finish_reason"])]
+            if include_usage:
+                frames.append(_sse_frame({
+                    "object": "chat.completion.chunk", "model": bundle.name,
+                    "choices": [],
+                    "usage": _usage(feats, ev["tokens"]),
+                }))
+            return frames
 
         return await _sse_stream(
             request, feats, item, t0, events,

@@ -302,6 +302,13 @@ class InferenceEngine:
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
         self.last_decode_steps: int | None = None
+        # Concurrent generate_stream count: the spec load gate must
+        # hold on the LEGACY per-stream path too (CONTINUOUS_BATCHING=0
+        # or oversized prompts) — without it, N concurrent streams all
+        # run per-stream speculative loops serialized on the engine
+        # lock, the under-load regression the gate exists to prevent.
+        self._live_streams = 0
+        self._live_streams_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # collation: list of per-item feature dicts -> padded device batch
@@ -564,40 +571,61 @@ class InferenceEngine:
 
         if self.bundle.kind != KIND_SEQ2SEQ:
             raise ValueError(f"{self.bundle.name} does not support streaming")
-        if self.spec_enabled and (
-            float(feats.get("temperature", 0.0)) == 0.0 or self.spec_sampled
-        ):
-            # Greedy streams verify by argmax identity; sampled ones by
-            # rejection sampling (SPEC_SAMPLED=0 opts them back out to
-            # the normal chunked path for cross-path seed stability).
-            yield from self._spec_stream(feats)
-            return
-        with self._lock:
-            # First chunk fused with encode+init (and routed through
-            # the per-request prefix cache): TTFT = one round-trip.
-            state, toks, sampled = self.start_fused(feats)
-            # One transfer for tokens+done — each device_get pays a full
-            # relay round-trip, so never fetch them separately.
-            toks_np, done_np = jax.device_get((toks, state.done))
-            chunk, done = toks_np[0], bool(done_np[0])
-        # Request max_tokens bounds chunk spending (the API layer trims
-        # to the exact token count).
-        budget = self.budget_for(feats)
-        produced = self.chunk_tokens
-        yield chunk
-        if done:
-            return
-        while produced < budget:
-            with self._lock:
-                state, toks = self._gen_chunk(
-                    self.params, state, self.chunk_tokens, sampled
+        with self._live_streams_lock:
+            self._live_streams += 1
+            # Spec load gate, held on THIS path too (the Batcher's gate
+            # only covers its continuous-loop routing): speculate only
+            # while the concurrent per-stream count (self included)
+            # stays within spec_max_streams.
+            spec_ok = self._live_streams <= int(
+                getattr(self.cfg, "spec_max_streams", 1)
+            )
+        try:
+            if (
+                self.spec_enabled
+                and spec_ok
+                and (
+                    float(feats.get("temperature", 0.0)) == 0.0
+                    or self.spec_sampled
                 )
+            ):
+                # Greedy streams verify by argmax identity; sampled
+                # ones by rejection sampling (SPEC_SAMPLED=0 opts them
+                # back out to the normal chunked path for cross-path
+                # seed stability).
+                yield from self._spec_stream(feats)
+                return
+            with self._lock:
+                # First chunk fused with encode+init (and routed
+                # through the per-request prefix cache): TTFT = one
+                # round-trip.
+                state, toks, sampled = self.start_fused(feats)
+                # One transfer for tokens+done — each device_get pays a
+                # full relay round-trip, so never fetch them
+                # separately.
                 toks_np, done_np = jax.device_get((toks, state.done))
                 chunk, done = toks_np[0], bool(done_np[0])
-            produced += self.chunk_tokens
+            # Request max_tokens bounds chunk spending (the API layer
+            # trims to the exact token count).
+            budget = self.budget_for(feats)
+            produced = self.chunk_tokens
             yield chunk
             if done:
                 return
+            while produced < budget:
+                with self._lock:
+                    state, toks = self._gen_chunk(
+                        self.params, state, self.chunk_tokens, sampled
+                    )
+                    toks_np, done_np = jax.device_get((toks, state.done))
+                    chunk, done = toks_np[0], bool(done_np[0])
+                produced += self.chunk_tokens
+                yield chunk
+                if done:
+                    return
+        finally:
+            with self._live_streams_lock:
+                self._live_streams -= 1
 
     def _spec_stream(self, feats: dict) -> Iterator[np.ndarray]:
         """Speculative streaming (greedy): each dispatch runs

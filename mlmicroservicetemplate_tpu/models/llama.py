@@ -73,6 +73,15 @@ class LlamaConfig:
     # bit-identical to the bf16 cache (quantization is lossy); the
     # knob ships measured (BASELINE.md) and default-off.
     kv_quant: bool = False
+    # Pallas decode attention (USE_PALLAS_DECODE=1): the single-token
+    # decode step's cache attention runs as one kernel gridded over
+    # (batch, KV head) — the cache crosses HBM once per KV HEAD
+    # instead of once per query head (no materialized GQA repeat), and
+    # under kv_quant the payload crosses at int8 width with in-kernel
+    # dequant (ops/attention.decode_attention).  Numerics: f32 scores/
+    # softmax like the jnp path (verified equal in tests/test_ops.py);
+    # serving-only, no VJP.
+    pallas_decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -331,7 +340,20 @@ def _write_kv(cache, rows_idx, pos_idx, k_new, dtype):
 
 def _cache_attention(cfg: LlamaConfig, q, ck, cv, mask):
     """Attention over a dense or int8-quantized KV cache (GQA repeat
-    applies to payloads and scales alike)."""
+    applies to payloads and scales alike).  With ``cfg.pallas_decode``
+    the single-query step runs the fused decode kernel instead: no
+    materialized GQA repeat, int8 payloads dequantized in-kernel."""
+    if cfg.pallas_decode and q.shape[1] == 1:
+        from ..ops.attention import decode_attention
+
+        m2 = mask[:, 0, 0, :]  # [B, 1, 1, T] -> [B, T]
+        if isinstance(ck, tuple):
+            ctx = decode_attention(
+                q[:, 0], ck[0], cv[0], m2, k_scale=ck[1], v_scale=cv[1]
+            )
+        else:
+            ctx = decode_attention(q[:, 0], ck, cv, m2)
+        return ctx[:, None]  # [B, 1, H, D]
     if isinstance(ck, tuple):
         return mha_attention_kv8(
             q,

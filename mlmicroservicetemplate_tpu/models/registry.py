@@ -637,6 +637,18 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     overrides.setdefault("pad_id", int(tokenizer.pad_id))
     if getattr(svc_cfg, "quant_kv", None) == "int8":
         overrides["kv_quant"] = True
+    # Pallas decode attention: measured opt-in (benchmarks/kv_quant_ab.py
+    # prints the A/B; see ops/attention.decode_attention).  TPU-gated
+    # like use_pallas_attention — the kernel has no CPU lowering, so a
+    # DEVICE=cpu run with the env set must fall back, not crash.
+    if _os.environ.get("USE_PALLAS_DECODE", "").lower() in ("1", "true", "yes"):
+        import jax as _jax
+
+        try:
+            if _jax.default_backend() == "tpu":
+                overrides["pallas_decode"] = True
+        except Exception:
+            pass
     cfg = llama_mod.LlamaConfig(**overrides)
 
     max_id = int(getattr(tokenizer, "max_token_id",

@@ -95,6 +95,108 @@ def _attn_body(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref, *, scale: float):
     o_ref[0, 0] = ctx.astype(o_ref.dtype)
 
 
+def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float):
+    # Blocks: q/o [1, 1, R, D] (R = GQA group width), k/v [1, T, 1, D],
+    # mask [1, 1, T].  One program = one (batch row, kv head): the K/V
+    # tile streams HBM→VMEM ONCE and serves all R query heads of its
+    # group — the XLA path's _repeat_kv reads it R times.
+    q = q_ref[0, 0].astype(jnp.float32)  # [R, D]
+    k = k_ref[0, :, 0].astype(jnp.float32)  # [T, D]
+    scores = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [R, T]
+    mask = mask_ref[0]  # [1, T]
+    scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1)
+    v = v_ref[0, :, 0]  # [T, D]
+    ctx = jax.lax.dot_general(
+        probs.astype(v.dtype), v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0] = ctx.astype(o_ref.dtype)
+
+
+def _decode_kernel_kv8(q_ref, k8_ref, ks_ref, v8_ref, vs_ref, mask_ref,
+                       o_ref, *, scale: float):
+    # int8-KV variant: payloads cross HBM at int8 width and dequantize
+    # IN VMEM — the hypothesis test for the measured XLA kv-quant loss
+    # (BASELINE.md r4: materialized int8→bf16 converts feeding the
+    # cache einsums).  Scale factoring is exact: the key scale
+    # multiplies its logit column, the value scale folds into the
+    # softmax weights (common.mha_attention_kv8's math, fused here).
+    q = q_ref[0, 0].astype(jnp.float32)  # [R, D]
+    k8 = k8_ref[0, :, 0].astype(jnp.float32)  # [T, D]
+    ks = ks_ref[0, :, 0, 0].astype(jnp.float32)  # [T]
+    scores = jax.lax.dot_general(
+        q, k8, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale * ks[None, :]  # [R, T]
+    mask = mask_ref[0]
+    scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1)
+    vs = vs_ref[0, :, 0, 0].astype(jnp.float32)  # [T]
+    v8 = v8_ref[0, :, 0].astype(jnp.float32)
+    ctx = jax.lax.dot_general(
+        probs * vs[None, :], v8,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0] = ctx.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def decode_attention(
+    q: jax.Array,  # [B, H, D] — one query per row (the decode step)
+    k: jax.Array,  # [B, T, KVH, D] dense, or int8 payload
+    v: jax.Array,  # [B, T, KVH, D]
+    mask: jax.Array,  # [B, T] 1 = attend
+    k_scale: jax.Array | None = None,  # [B, T, KVH, 1] → int8 path
+    v_scale: jax.Array | None = None,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode-side fused attention over the KV cache; returns [B, H, D].
+
+    Grid (B, KVH): each program serves one kv head's whole GQA query
+    group, so the cache crosses HBM once per kv head instead of once
+    per query head (``_repeat_kv``), and with ``k_scale``/``v_scale``
+    the payload crosses at int8 width with in-kernel dequant.  The
+    [T, D] tile + f32 copies fit VMEM comfortably at serving contexts
+    (T=2048, D=64 ≈ 0.5 MB f32)."""
+    from jax.experimental import pallas as pl
+
+    b, h, d = q.shape
+    _, t, kvh, _ = k.shape
+    n_rep = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, kvh, n_rep, d)
+    q_spec = pl.BlockSpec((1, 1, n_rep, d), lambda i, g: (i, g, 0, 0))
+    kv_spec = pl.BlockSpec((1, t, 1, d), lambda i, g: (i, 0, g, 0))
+    mask3 = mask.astype(jnp.int32)[:, None, :]
+    mask_spec = pl.BlockSpec((1, 1, t), lambda i, g: (i, 0, 0))
+    if k_scale is None:
+        kernel = functools.partial(_decode_kernel, scale=scale)
+        in_specs = [q_spec, kv_spec, kv_spec, mask_spec]
+        args = (qg, k, v, mask3)
+    else:
+        sc_spec = pl.BlockSpec((1, t, 1, 1), lambda i, g: (i, 0, g, 0))
+        kernel = functools.partial(_decode_kernel_kv8, scale=scale)
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
+        args = (qg, k, k_scale, v, v_scale, mask3)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, kvh),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, n_rep, d), q.dtype),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, d)
+
+
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def fused_attention(
     q: jax.Array,  # [B, S, H, D]

@@ -36,3 +36,47 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+# ---------------------------------------------------------------------------
+# Test tiers: `pytest -m quick` is the <2-minute CI loop; the full
+# suite (~15 min on this 1-vCPU box) stays the pre-commit bar.
+#
+# Classification is data-driven: tests/measured_durations.json maps
+# node ids to measured call seconds (regenerate with
+# `pytest -q --durations=0` and the helper in its header); anything at
+# or above _SLOW_THRESHOLD_S is marked `slow`, everything else
+# (including tests too new to have a measurement) is `quick`.
+
+_SLOW_THRESHOLD_S = 3.0
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: fast tier (pytest -m quick, <2 min total)"
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: measured >= 3s on the reference box (excluded from -m quick)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    import json
+    import pathlib
+
+    path = pathlib.Path(__file__).parent / "measured_durations.json"
+    try:
+        durations = json.loads(path.read_text())
+    except Exception:
+        durations = {}
+    for item in items:
+        # Node ids in the file are relative to the repo root
+        # ("tests/test_x.py::test_y").
+        nid = item.nodeid
+        if not nid.startswith("tests/"):
+            nid = f"tests/{nid}"
+        if durations.get(nid, 0.0) >= _SLOW_THRESHOLD_S:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)

@@ -773,18 +773,32 @@ def test_v1_models_usage_and_explicit_400s():
         assert u["prompt_tokens"] > 0 and u["completion_tokens"] >= 1
         assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
 
-        # Streaming completions: final data chunk (pre-[DONE]) carries it.
+        # Streaming: usage appears ONLY when stream_options asks — the
+        # OpenAI contract.  Unsolicited: no usage key on any chunk.
         r = await client.post(
             "/v1/completions", json={"prompt": "summarize: hi", "stream": True}
         )
         events = [l[len("data: "):] for l in (await r.text()).splitlines()
                   if l.startswith("data: ")]
         assert events[-1] == "[DONE]"
+        assert all("usage" not in json.loads(e) for e in events[:-1])
+        # Requested: every chunk has usage: null, and one extra final
+        # chunk (empty choices) carries the numbers.
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "summarize: hi", "stream": True,
+                  "stream_options": {"include_usage": True}},
+        )
+        events = [l[len("data: "):] for l in (await r.text()).splitlines()
+                  if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
         final = json.loads(events[-2])
+        assert final["choices"] == []
         assert final["usage"]["total_tokens"] == (
             final["usage"]["prompt_tokens"] + final["usage"]["completion_tokens"]
         )
         assert final["usage"]["completion_tokens"] >= 1
+        assert all(json.loads(e)["usage"] is None for e in events[:-2])
 
         # Chat: both shapes too.
         messages = [{"role": "user", "content": "summarize: hi"}]
@@ -792,12 +806,20 @@ def test_v1_models_usage_and_explicit_400s():
         u = (await r.json())["usage"]
         assert u["completion_tokens"] >= 1 and u["prompt_tokens"] > 0
         r = await client.post(
-            "/v1/chat/completions", json={"messages": messages, "stream": True}
+            "/v1/chat/completions",
+            json={"messages": messages, "stream": True,
+                  "stream_options": {"include_usage": True}},
         )
         events = [l[len("data: "):] for l in (await r.text()).splitlines()
                   if l.startswith("data: ")]
         final = json.loads(events[-2])
-        assert final["usage"]["completion_tokens"] >= 1
+        assert final["choices"] == [] and final["usage"]["completion_tokens"] >= 1
+        r = await client.post(
+            "/v1/chat/completions", json={"messages": messages, "stream": True}
+        )
+        events = [l[len("data: "):] for l in (await r.text()).splitlines()
+                  if l.startswith("data: ")]
+        assert all("usage" not in json.loads(e) for e in events[:-1])
 
         # max_tokens caps completion_tokens exactly.
         r = await client.post(
@@ -845,7 +867,9 @@ def test_usage_stop_truncation_consistent_and_logprobs_zero():
             assert stop not in out["choices"][0]["text"]
             r = await client.post(
                 "/v1/completions",
-                json={"prompt": "summarize: hello", "stop": stop, "stream": True},
+                json={"prompt": "summarize: hello", "stop": stop,
+                      "stream": True,
+                      "stream_options": {"include_usage": True}},
             )
             events = [l[len("data: "):] for l in (await r.text()).splitlines()
                       if l.startswith("data: ")]

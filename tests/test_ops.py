@@ -103,3 +103,104 @@ def test_pallas_flag_requires_tpu_and_honors_disable(monkeypatch):
     assert ops_attn.use_pallas_attention(max_seq=2048) is False
     monkeypatch.setenv("USE_PALLAS_ATTENTION", "1")
     assert ops_attn.use_pallas_attention(max_seq=2048) is True
+
+
+@pytest.mark.parametrize("n_rep", [1, 4])
+def test_decode_attention_matches_reference(n_rep):
+    """Fused decode kernel (grid over kv heads, GQA group as the query
+    tile) == mha_attention over the repeated cache, dense bf16-free f32
+    numerics on CPU interpret."""
+    from mlmicroservicetemplate_tpu.models.common import mha_attention
+    from mlmicroservicetemplate_tpu.models.llama import _repeat_kv
+    from mlmicroservicetemplate_tpu.ops.attention import decode_attention
+
+    b, t, kvh, d = 3, 40, 2, 16
+    h = kvh * n_rep
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, t, kvh, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, t, kvh, d)).astype(np.float32))
+    valid = rng.integers(0, 2, (b, t)).astype(np.int32)
+    valid[:, 0] = 1  # every row attends to something
+    mask = jnp.asarray(valid)
+
+    want = mha_attention(
+        q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep),
+        mask=(mask != 0)[:, None, None, :],
+    )[:, 0]
+    got = decode_attention(q[:, 0], k, v, mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_attention_kv8_matches_reference():
+    """int8 in-kernel dequant == mha_attention_kv8 over the repeated
+    quantized cache (exact scale factoring both sides)."""
+    from mlmicroservicetemplate_tpu.models.common import (
+        kv_quantize,
+        mha_attention_kv8,
+    )
+    from mlmicroservicetemplate_tpu.models.llama import _repeat_kv
+    from mlmicroservicetemplate_tpu.ops.attention import decode_attention
+
+    b, t, kvh, n_rep, d = 2, 24, 2, 4, 16
+    h = kvh * n_rep
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, t, kvh, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, t, kvh, d)).astype(np.float32))
+    k8, ks = kv_quantize(k)
+    v8, vs = kv_quantize(v)
+    valid = rng.integers(0, 2, (b, t)).astype(np.int32)
+    valid[:, 0] = 1
+    mask = jnp.asarray(valid)
+
+    want = mha_attention_kv8(
+        q,
+        _repeat_kv(k8, n_rep), _repeat_kv(ks, n_rep),
+        _repeat_kv(v8, n_rep), _repeat_kv(vs, n_rep),
+        mask=(mask != 0)[:, None, None, :],
+    )[:, 0]
+    got = decode_attention(
+        q[:, 0], k8, v8, mask, k_scale=ks, v_scale=vs, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_pallas_decode_token_identity():
+    """cfg.pallas_decode generation (interpret on CPU) emits the same
+    tokens as the jnp cache-attention path, dense and kv_quant."""
+    from unittest import mock
+
+    from mlmicroservicetemplate_tpu.models import llama as llama_mod
+    from mlmicroservicetemplate_tpu.ops import attention as ops_attn
+
+    base = dict(
+        vocab_size=31, d_model=32, num_heads=4, num_kv_heads=2,
+        num_layers=2, d_ff=64, max_position=128, eos_id=2, pad_id=0,
+    )
+    rng = np.random.default_rng(2)
+    ids = np.tile(rng.integers(3, 30, 4), 3)[None].astype(np.int32)
+    mask = np.ones_like(ids)
+
+    real = ops_attn.decode_attention
+
+    def interp(*args, **kw):
+        kw["interpret"] = True
+        return real(*args, **kw)
+
+    for quant in (False, True):
+        cfg_ref = llama_mod.LlamaConfig(kv_quant=quant, **base)
+        cfg_pl = llama_mod.LlamaConfig(
+            kv_quant=quant, pallas_decode=True, **base
+        )
+        params = llama_mod.init_params(jax.random.PRNGKey(5), cfg_ref)
+        ref = np.asarray(llama_mod.greedy_generate(
+            params, cfg_ref, jnp.asarray(ids), jnp.asarray(mask), 16
+        ))
+        with mock.patch.object(ops_attn, "decode_attention", interp):
+            got = np.asarray(llama_mod.greedy_generate(
+                params, cfg_pl, jnp.asarray(ids), jnp.asarray(mask), 16
+            ))
+        np.testing.assert_array_equal(got, ref, err_msg=f"kv_quant={quant}")
