@@ -41,10 +41,10 @@ def step_ms(kv_quant: bool, s_len: int, pallas: bool = False) -> tuple[float, bo
     from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
     from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
 
-    if pallas:
-        os.environ["USE_PALLAS_DECODE"] = "1"
-    else:
-        os.environ.pop("USE_PALLAS_DECODE", None)
+    # Explicit both ways: pallas_decode now AUTO-enables with kv_quant
+    # on TPU, so the XLA baseline arm must force it OFF (popping the
+    # env would silently measure Pallas-vs-Pallas).
+    os.environ["USE_PALLAS_DECODE"] = "1" if pallas else "0"
     cfg = ServiceConfig(
         device=os.environ.get("DEVICE", "tpu"),
         model_name=os.environ.get("MODEL_NAME", "llama"),

@@ -637,18 +637,52 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     overrides.setdefault("pad_id", int(tokenizer.pad_id))
     if getattr(svc_cfg, "quant_kv", None) == "int8":
         overrides["kv_quant"] = True
-    # Pallas decode attention: measured opt-in (benchmarks/kv_quant_ab.py
-    # prints the A/B; see ops/attention.decode_attention).  TPU-gated
-    # like use_pallas_attention — the kernel has no CPU lowering, so a
-    # DEVICE=cpu run with the env set must fall back, not crash.
-    if _os.environ.get("USE_PALLAS_DECODE", "").lower() in ("1", "true", "yes"):
+    # Pallas decode attention (ops/attention.decode_attention).
+    # Measured policy (benchmarks/kv_quant_ab.py, v5e, llama-1.1B
+    # int8 weights, B=8): int8-KV through the fused kernel beats the
+    # dense XLA path 1.32-1.58x across contexts 512-1792 — in-kernel
+    # dequant is what flips round-4's 0.89-0.90x XLA kv-quant loss —
+    # while the DENSE kernel variant loses slightly (0.86-0.96x).  So
+    # the default follows the measurement: ON exactly when the int8 KV
+    # cache is on.  USE_PALLAS_DECODE=1 forces it for dense too,
+    # =0 disables.  TPU-gated like use_pallas_attention — the kernel
+    # has no CPU lowering, so a CPU run must fall back, not crash.
+    env_pd = _os.environ.get("USE_PALLAS_DECODE", "").lower()
+    want_pd = (
+        env_pd in ("1", "true", "yes")
+        or (env_pd not in ("0", "false", "no") and overrides.get("kv_quant"))
+    )
+    if want_pd:
+        import math as _math
+
         import jax as _jax
 
+        from ..ops.attention import decode_kernel_fits
+
+        # Worst-case cache width this deployment can reach (QUANT_KV
+        # excludes cached prefixes, so p_len = 0): largest prompt
+        # bucket + the chunk-rounded decode budget.
+        chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
+        t_est = max(svc_cfg.seq_buckets) + int(
+            _math.ceil(svc_cfg.max_decode_len / chunk) * chunk
+        )
+        probe = llama_mod.LlamaConfig(
+            **{k: v for k, v in overrides.items() if k != "pallas_decode"}
+        )
         try:
-            if _jax.default_backend() == "tpu":
-                overrides["pallas_decode"] = True
+            on_tpu = _jax.default_backend() == "tpu"
         except Exception:
-            pass
+            on_tpu = False
+        if on_tpu and decode_kernel_fits(
+            t_est, probe.num_kv_heads, probe.head_dim
+        ):
+            overrides["pallas_decode"] = True
+        elif env_pd in ("1", "true", "yes"):
+            log.warning(
+                "USE_PALLAS_DECODE requested but unavailable "
+                "(backend!=tpu or slab exceeds VMEM at T=%d); using the "
+                "jnp cache-attention path", t_est,
+            )
     cfg = llama_mod.LlamaConfig(**overrides)
 
     max_id = int(getattr(tokenizer, "max_token_id",

@@ -95,55 +95,78 @@ def _attn_body(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref, *, scale: float):
     o_ref[0, 0] = ctx.astype(o_ref.dtype)
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float):
-    # Blocks: q/o [1, 1, R, D] (R = GQA group width), k/v [1, T, 1, D],
-    # mask [1, 1, T].  One program = one (batch row, kv head): the K/V
-    # tile streams HBM→VMEM ONCE and serves all R query heads of its
-    # group — the XLA path's _repeat_kv reads it R times.
-    q = q_ref[0, 0].astype(jnp.float32)  # [R, D]
-    k = k_ref[0, :, 0].astype(jnp.float32)  # [T, D]
-    scores = jax.lax.dot_general(
-        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [R, T]
+def _decode_body(q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref, *,
+                 scale: float, kvh: int):
+    # Blocks: q/o [1, KVH, R, D] (R = GQA group width), k/v
+    # [1, T, KVH, D], scales (int8 path) [1, T, KVH], mask [1, 1, T].
+    # One program = one batch row: the whole row's cache slab streams
+    # HBM->VMEM exactly ONCE and the (static) kv-head loop serves
+    # every query group from it — the XLA path's _repeat_kv costs one
+    # cache read per QUERY head.  (Blocking the KVH axis instead would
+    # need a sublane-divisible block there, which Mosaic's
+    # (8, 128)-or-whole-dim rule rejects for small head counts;
+    # whole-slab blocks satisfy it trivially.)
+    #
+    # With scale refs the payloads are int8 and dequantize IN VMEM —
+    # the hypothesis test for the measured XLA kv-quant loss
+    # (BASELINE.md r4: materialized int8->bf16 converts feeding the
+    # cache einsums).  Scales fold into the dequantized tiles
+    # ((q·k8)·ks == q·(k8·ks) exactly in real arithmetic); everything
+    # stays >=2-D — Mosaic's layout inference rejects 1-D vector
+    # extractions like [1,T,1,1]->[T].
     mask = mask_ref[0]  # [1, T]
-    scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
-    probs = jax.nn.softmax(scores, axis=-1)
-    v = v_ref[0, :, 0]  # [T, D]
-    ctx = jax.lax.dot_general(
-        probs.astype(v.dtype), v,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[0, 0] = ctx.astype(o_ref.dtype)
+    ks_all = None if ks_ref is None else ks_ref[0].astype(jnp.float32)
+    vs_all = None if vs_ref is None else vs_ref[0].astype(jnp.float32)
+    for g in range(kvh):
+        q = q_ref[0, g].astype(jnp.float32)  # [R, D]
+        k = k_ref[0, :, g].astype(jnp.float32)  # [T, D]
+        if ks_all is not None:
+            k = k * ks_all[:, g:g + 1]
+        scores = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [R, T]
+        scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
+        probs = jax.nn.softmax(scores, axis=-1)
+        v = v_ref[0, :, g]  # [T, D]
+        if vs_all is not None:
+            v = v.astype(jnp.float32) * vs_all[:, g:g + 1]
+            probs_t = probs
+        else:
+            probs_t = probs.astype(v.dtype)
+        ctx = jax.lax.dot_general(
+            probs_t, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, g] = ctx.astype(o_ref.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float,
+                   kvh: int):
+    _decode_body(q_ref, k_ref, v_ref, None, None, mask_ref, o_ref,
+                 scale=scale, kvh=kvh)
 
 
 def _decode_kernel_kv8(q_ref, k8_ref, ks_ref, v8_ref, vs_ref, mask_ref,
-                       o_ref, *, scale: float):
-    # int8-KV variant: payloads cross HBM at int8 width and dequantize
-    # IN VMEM — the hypothesis test for the measured XLA kv-quant loss
-    # (BASELINE.md r4: materialized int8→bf16 converts feeding the
-    # cache einsums).  Scale factoring is exact: the key scale
-    # multiplies its logit column, the value scale folds into the
-    # softmax weights (common.mha_attention_kv8's math, fused here).
-    q = q_ref[0, 0].astype(jnp.float32)  # [R, D]
-    k8 = k8_ref[0, :, 0].astype(jnp.float32)  # [T, D]
-    ks = ks_ref[0, :, 0, 0].astype(jnp.float32)  # [T]
-    scores = jax.lax.dot_general(
-        q, k8, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale * ks[None, :]  # [R, T]
-    mask = mask_ref[0]
-    scores = jnp.where(mask[0][None, :] != 0, scores, jnp.float32(-1e9))
-    probs = jax.nn.softmax(scores, axis=-1)
-    vs = vs_ref[0, :, 0, 0].astype(jnp.float32)  # [T]
-    v8 = v8_ref[0, :, 0].astype(jnp.float32)
-    ctx = jax.lax.dot_general(
-        probs * vs[None, :], v8,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[0, 0] = ctx.astype(o_ref.dtype)
+                       o_ref, *, scale: float, kvh: int):
+    _decode_body(q_ref, k8_ref, v8_ref, ks_ref, vs_ref, mask_ref, o_ref,
+                 scale=scale, kvh=kvh)
+
+
+# Per-program VMEM for the whole-slab decode kernel: K+V f32 copies
+# dominate (2·T·KVH·D·4B) on top of the raw blocks.  Guard the
+# auto-enable against configs whose slabs cannot fit, mirroring
+# use_pallas_attention's single-block guard.
+DECODE_KERNEL_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def decode_kernel_fits(t: int, kvh: int, d: int) -> bool:
+    """True when the per-program slabs of ``decode_attention`` fit the
+    VMEM budget at cache width ``t`` (f32 K+V copies + raw payloads)."""
+    f32_copies = 2 * t * kvh * d * 4
+    payloads = 2 * t * kvh * d * 4  # bf16/int8 blocks + scales, rounded up
+    return f32_copies + payloads <= DECODE_KERNEL_VMEM_BUDGET
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
@@ -152,19 +175,18 @@ def decode_attention(
     k: jax.Array,  # [B, T, KVH, D] dense, or int8 payload
     v: jax.Array,  # [B, T, KVH, D]
     mask: jax.Array,  # [B, T] 1 = attend
-    k_scale: jax.Array | None = None,  # [B, T, KVH, 1] → int8 path
+    k_scale: jax.Array | None = None,  # [B, T, KVH, 1] -> int8 path
     v_scale: jax.Array | None = None,
     scale: float | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Decode-side fused attention over the KV cache; returns [B, H, D].
 
-    Grid (B, KVH): each program serves one kv head's whole GQA query
-    group, so the cache crosses HBM once per kv head instead of once
-    per query head (``_repeat_kv``), and with ``k_scale``/``v_scale``
-    the payload crosses at int8 width with in-kernel dequant.  The
-    [T, D] tile + f32 copies fit VMEM comfortably at serving contexts
-    (T=2048, D=64 ≈ 0.5 MB f32)."""
+    Grid (B,): each program serves one batch row — its whole KV slab
+    crosses HBM once (the XLA path's ``_repeat_kv`` costs one read per
+    query head), and with ``k_scale``/``v_scale`` the payload crosses
+    at int8 width with in-kernel dequant.  VMEM: the [T, KVH, D] slab
+    + f32 copies ~= 4.6 MB at T=2048, KVH=4, D=64 — comfortable."""
     from jax.experimental import pallas as pl
 
     b, h, d = q.shape
@@ -173,22 +195,24 @@ def decode_attention(
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     qg = q.reshape(b, kvh, n_rep, d)
-    q_spec = pl.BlockSpec((1, 1, n_rep, d), lambda i, g: (i, g, 0, 0))
-    kv_spec = pl.BlockSpec((1, t, 1, d), lambda i, g: (i, 0, g, 0))
+    q_spec = pl.BlockSpec((1, kvh, n_rep, d), lambda i: (i, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, t, kvh, d), lambda i: (i, 0, 0, 0))
     mask3 = mask.astype(jnp.int32)[:, None, :]
-    mask_spec = pl.BlockSpec((1, 1, t), lambda i, g: (i, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, t), lambda i: (i, 0, 0))
     if k_scale is None:
-        kernel = functools.partial(_decode_kernel, scale=scale)
+        kernel = functools.partial(_decode_kernel, scale=scale, kvh=kvh)
         in_specs = [q_spec, kv_spec, kv_spec, mask_spec]
         args = (qg, k, v, mask3)
     else:
-        sc_spec = pl.BlockSpec((1, t, 1, 1), lambda i, g: (i, 0, g, 0))
-        kernel = functools.partial(_decode_kernel_kv8, scale=scale)
+        sc_spec = pl.BlockSpec((1, t, kvh), lambda i: (i, 0, 0))
+        kernel = functools.partial(_decode_kernel_kv8, scale=scale, kvh=kvh)
         in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
-        args = (qg, k, k_scale, v, v_scale, mask3)
+        args = (
+            qg, k, k_scale[..., 0], v, v_scale[..., 0], mask3
+        )
     out = pl.pallas_call(
         kernel,
-        grid=(b, kvh),
+        grid=(b,),
         in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, n_rep, d), q.dtype),
