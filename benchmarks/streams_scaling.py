@@ -29,7 +29,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 MODEL = os.environ.get("MODEL_NAME", "gpt2")
-PROMPT = "the quick brown fox jumps over the lazy dog and keeps going"
+# BENCH_PROMPT picks the traffic shape: the default is generic English
+# (the spec_continuous column's honest base case); a repetition-heavy
+# prompt (e.g. "a b c a b c ...") measures the quoting regime the
+# speculative loop targets.
+PROMPT = os.environ.get(
+    "BENCH_PROMPT",
+    "the quick brown fox jumps over the lazy dog and keeps going",
+)
 DECODE = int(os.environ.get("BENCH_DECODE_LEN", "32"))
 CHUNK = int(os.environ.get("BENCH_CHUNK", "8"))
 LEVELS = (1, 2, 4, 8)
