@@ -1,0 +1,238 @@
+"""Composed decode levers A/B (round-6 tentpole): PREFIX_CACHE ×
+SPEC_CONTINUOUS × QUANT_KV stacked in ONE deployment vs each single
+lever, on the north-star workload — long-context chat/summarization
+with shared prompt prefixes served at widths 1–8.
+
+Before round 6 the registry forced operators to pick exactly one of
+{per-request prefix cache, continuous speculation, int8 KV + fused
+Pallas decode}; this measures whether the now-composable stack earns
+its keep: aggregate tokens/s through the continuous-batching loop for
+five configs —
+
+  base     continuous batching only (int8 weights, like all rows)
+  prefix   + PREFIX_CACHE=1        (suffix-only prefill on hits)
+  spec     + SPEC_CONTINUOUS=1     (draft→verify rounds in the loop)
+  kv8      + QUANT_KV=int8         (int8 KV; Pallas decode on TPU)
+  stacked  all three at once
+
+over shared prefixes of 512/768 tokens (COMPOSE_PREFIXES), distinct
+per-stream suffixes, widths 1/2/4/8 (COMPOSE_WIDTHS), decode budget
+128 (COMPOSE_DECODE) on repetition-heavy traffic (the quoting regime
+speculation targets; prefix caches are seeded by one solo request
+before the clock starts, so measured admissions HIT).  Per cell the
+summary records stacked vs the best single lever — honest negatives
+stay in the table.
+
+    python benchmarks/compose_ab.py               # TPU, llama-1.1B int8
+    DEVICE=cpu python benchmarks/compose_ab.py    # tiny-dims sanity run
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+DEVICE = os.environ.get("DEVICE", "tpu")
+CPU_SANITY = DEVICE == "cpu" and "LLAMA_CONFIG" not in os.environ
+if CPU_SANITY:
+    # A 1.1B llama on a CPU host is not a benchmark, it is a hang:
+    # shrink to tiny dims + short prefixes so the HARNESS stays
+    # exercisable anywhere.  Numbers from this mode are labeled and
+    # must never be quoted as performance.
+    os.environ["LLAMA_CONFIG"] = json.dumps(dict(
+        vocab_size=512, d_model=64, num_heads=4, num_kv_heads=2,
+        num_layers=2, d_ff=128, max_position=512,
+    ))
+
+_dflt = "32" if CPU_SANITY else "512,768"
+PREFIXES = tuple(
+    int(x) for x in os.environ.get("COMPOSE_PREFIXES", _dflt).split(",")
+)
+WIDTHS = tuple(
+    int(x) for x in os.environ.get("COMPOSE_WIDTHS", "1,2,4,8").split(",")
+)
+DECODE = int(os.environ.get("COMPOSE_DECODE", "32" if CPU_SANITY else "128"))
+CHUNK = int(os.environ.get("COMPOSE_CHUNK", "8" if CPU_SANITY else "16"))
+SUFFIX_LEN = int(os.environ.get("COMPOSE_SUFFIX", "12" if CPU_SANITY else "48"))
+SUFFIX_BUCKET = int(
+    os.environ.get("COMPOSE_SUFFIX_BUCKET", "16" if CPU_SANITY else "64")
+)
+SPEC_K = int(os.environ.get("SPEC_K", "8"))
+
+CONFIGS: dict[str, dict] = {
+    "base": {},
+    "prefix": {"prefix_cache": True},
+    "spec": {"spec_decode": "ngram", "spec_continuous": True,
+             "spec_k": SPEC_K},
+    "kv8": {"quant_kv": "int8"},
+    "stacked": {"prefix_cache": True, "quant_kv": "int8",
+                "spec_decode": "ngram", "spec_continuous": True,
+                "spec_k": SPEC_K},
+}
+
+
+def build_engine(levers: dict, p_len: int):
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    cfg = ServiceConfig(
+        device=DEVICE,
+        model_name="llama",
+        quantize=(os.environ.get("QUANTIZE", "int8") or None),
+        warmup=False,
+        batch_buckets=(1,),
+        # Suffix bucket for hit prefills, the prefix bucket itself, and
+        # the full-prompt bucket for misses; the prefix guard needs
+        # p_len + suffix bucket <= the max bucket, satisfied exactly.
+        seq_buckets=(SUFFIX_BUCKET, p_len, p_len + SUFFIX_BUCKET),
+        max_decode_len=DECODE,
+        stream_chunk_tokens=CHUNK,
+        max_streams=max(WIDTHS),
+        **levers,
+    )
+    bundle = build_model(cfg)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    return eng, cfg, bundle
+
+
+def make_prompts(p_len: int, n: int, vocab: int, seed: int = 0):
+    """Shared repetition-heavy prefix + distinct suffixes that continue
+    the pattern (the quoting regime: prompt-lookup drafts land)."""
+    rng = np.random.default_rng(seed)
+    pat = rng.integers(5, vocab - 1, 16).astype(np.int32)
+    prefix = np.tile(pat, p_len // pat.size + 1)[:p_len]
+    prompts = []
+    for i in range(n):
+        suf = np.tile(pat, SUFFIX_LEN // pat.size + 1)[:SUFFIX_LEN].copy()
+        suf[:4] = rng.integers(5, vocab - 1, 4)  # distinct per stream
+        prompts.append(np.concatenate([prefix, suf]))
+    seed_suf = np.tile(pat, SUFFIX_LEN // pat.size + 1)[:SUFFIX_LEN].copy()
+    seed_suf[:4] = rng.integers(5, vocab - 1, 4)
+    return np.concatenate([prefix, seed_suf]), prompts
+
+
+def feats(ids: np.ndarray) -> dict:
+    return {"input_ids": ids, "length": np.int32(ids.size)}
+
+
+def measure(cdl, prompts: list[np.ndarray], n: int) -> dict:
+    """Aggregate tokens/s for ``n`` concurrent streams through the
+    continuous loop (streams_scaling's measurement, prefix-aware)."""
+
+    async def consume(gen):
+        toks = 0
+        async for chunk in gen:
+            toks += int(np.asarray(chunk).size)
+        return toks
+
+    async def body():
+        gens = [cdl.submit_stream(feats(prompts[i])) for i in range(n)]
+        return await asyncio.gather(*[consume(g) for g in gens])
+
+    pre_chunks = cdl.chunk_dispatches
+    pre_fills = cdl.prefill_dispatches
+    t0 = time.perf_counter()
+    counts = asyncio.run(body())
+    wall = time.perf_counter() - t0
+    # This bench reuses ONE loop across widths but runs each width
+    # under its own short-lived asyncio.run loop, which can close
+    # before the thread-safe admission-release callbacks land (a
+    # long-lived server loop never does).  Wait for the drain, then
+    # reset the counter to the drained truth so later widths aren't
+    # shed by leaked admissions.
+    deadline = time.monotonic() + 30
+    while (cdl.active or not cdl.pending.empty()) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    cdl._admitted = 0
+    return {
+        "tokens": int(sum(counts)),
+        "wall_s": round(wall, 3),
+        "tok_s": round(sum(counts) / wall, 1),
+        "chunk_dispatches": cdl.chunk_dispatches - pre_chunks,
+        "prefill_dispatches": cdl.prefill_dispatches - pre_fills,
+    }
+
+
+def run_config(name: str, levers: dict, p_len: int) -> dict:
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+
+    eng, cfg, bundle = build_engine(levers, p_len)
+    vocab = int(bundle.cfg.vocab_size)
+    seed_prompt, prompts = make_prompts(p_len, max(WIDTHS), vocab)
+    # Seed the prefix cache off the clock (one solo request donates at
+    # bucket p_len), and warm the solo path's executables for every
+    # config so no cell pays a first-compile.
+    for _ in eng.generate_stream(feats(seed_prompt)):
+        pass
+    if eng.prefix_cache is not None:
+        assert eng.prefix_cache.stats()["entries"] >= 1, "seeding failed"
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.warm()
+    cells = {}
+    for n in WIDTHS:
+        cells[f"w{n}"] = measure(cdl, prompts, n)
+    hits = eng.prefix_cache.stats() if eng.prefix_cache is not None else None
+    cdl.stop()
+    out = {"config": name, "prefix": p_len, **{
+        k: v["tok_s"] for k, v in cells.items()
+    }, "cells": cells}
+    if hits is not None:
+        out["prefix_cache"] = {k: hits[k] for k in ("hits", "misses", "entries")}
+    return out
+
+
+def main() -> None:
+    from mlmicroservicetemplate_tpu.runtime.device import apply_device_env
+
+    apply_device_env(DEVICE)
+    import jax
+
+    rows = []
+    for p_len in PREFIXES:
+        per_cfg = {}
+        for name, levers in CONFIGS.items():
+            row = run_config(name, levers, p_len)
+            per_cfg[name] = row
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        # Per-cell verdict: stacked vs the best single lever (honest
+        # negatives print as ratios < 1).
+        verdict = {"prefix": p_len}
+        for n in WIDTHS:
+            k = f"w{n}"
+            singles = {c: per_cfg[c][k] for c in ("base", "prefix", "spec", "kv8")}
+            best = max(singles, key=singles.get)
+            stacked = per_cfg["stacked"][k]
+            verdict[k] = {
+                "stacked_tok_s": stacked,
+                "best_single": best,
+                "best_single_tok_s": singles[best],
+                "stacked_vs_best": round(
+                    stacked / max(singles[best], 1e-9), 3
+                ),
+            }
+        rows.append({"verdict": verdict})
+        print(json.dumps({"verdict": verdict}), flush=True)
+    print(json.dumps({
+        "bench": "compose_ab",
+        "model": "llama",
+        "weights": os.environ.get("QUANTIZE", "int8") or "bf16",
+        "decode": DECODE, "chunk": CHUNK, "suffix": SUFFIX_LEN,
+        "widths": list(WIDTHS), "prefixes": list(PREFIXES),
+        "backend": jax.default_backend(),
+        "cpu_sanity": CPU_SANITY,
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

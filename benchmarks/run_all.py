@@ -101,6 +101,18 @@ async def main() -> None:
         print(f"| {row['config']} | {metrics} | {backend} |", file=sys.stderr)
         print(json.dumps({**row, "backend": backend}))
 
+    # Composed decode levers (round-6 tentpole): the stacked
+    # PREFIX_CACHE × SPEC_CONTINUOUS × QUANT_KV llama deployment vs
+    # each single lever, in a subprocess so its five engine builds
+    # can't disturb the table above.  COMPOSE_AB=0 skips.
+    if os.environ.get("COMPOSE_AB", "1").lower() not in ("0", "false", "no"):
+        import subprocess
+
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "compose_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

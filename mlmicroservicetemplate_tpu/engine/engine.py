@@ -548,16 +548,22 @@ class InferenceEngine:
         if p_len not in self._slice_prefix:
             from jax import lax
 
+            def cut(c, r):
+                # A kv_quant cache entry is an (int8 payload, scale)
+                # tuple: slice both so the captured prefix stays int8 —
+                # half the cache-budget bytes, and the EXACT rows a
+                # later quantized init copies back bit-identically.
+                if isinstance(c, tuple):
+                    return tuple(
+                        lax.dynamic_slice_in_dim(x, r, 1, axis=0)[:, :p_len]
+                        for x in c
+                    )
+                return lax.dynamic_slice_in_dim(c, r, 1, axis=0)[:, :p_len]
+
             def slc(st, r):
                 return {
-                    "k": [
-                        lax.dynamic_slice_in_dim(c, r, 1, axis=0)[:, :p_len]
-                        for c in st.cache_k
-                    ],
-                    "v": [
-                        lax.dynamic_slice_in_dim(c, r, 1, axis=0)[:, :p_len]
-                        for c in st.cache_v
-                    ],
+                    "k": [cut(c, r) for c in st.cache_k],
+                    "v": [cut(c, r) for c in st.cache_v],
                 }
 
             self._slice_prefix[p_len] = jax.jit(slc)

@@ -26,6 +26,12 @@ TPU-first constraints shape the design:
   in-flight request keeps its prefix arrays alive past eviction; the
   LRU byte budget (``PREFIX_CACHE_MB``) bounds what the CACHE pins,
   not what requests hold.
+- **Entries inherit the serving cache's dtype**: under QUANT_KV the
+  engine's capture slicer cuts the int8 cache rows themselves, so each
+  per-layer entry is an (int8 payload, per-token scale) tuple — about
+  half the budget bytes of a dense bf16 entry (twice the conversations
+  per MB), re-absorbed bit-exactly on hits, and the same pytree rides
+  through match/insert/evict unchanged (byte accounting walks leaves).
 
 Mutually exclusive with the global PROMPT_PREFIX (its KV occupies
 positions 0..P_global, which per-request prefixes would collide with);
@@ -52,7 +58,8 @@ def _key(ids: np.ndarray, p: int) -> bytes:
 
 
 class PrefixCache:
-    """LRU {(P, hash(tokens[:P])) -> per-layer KV pytree [1, P, H, D]}."""
+    """LRU {(P, hash(tokens[:P])) -> per-layer KV pytree [1, P, H, D]
+    (dense) or ([1, P, H, D] int8, [1, P, H, 1] scale) under QUANT_KV}."""
 
     def __init__(self, buckets: tuple[int, ...], budget_mb: float = 256.0):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))

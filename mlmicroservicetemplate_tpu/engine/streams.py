@@ -128,18 +128,20 @@ class ContinuousDecodeLoop:
         # histories and the shared chunk runs draft→verify rounds
         # (models/spec.py), so every live stream keeps the accepted-
         # token multiplier — each round emits 1..spec_k+1 tokens per
-        # row instead of exactly 1.  Excluded under the per-request
-        # prefix cache: hit states carry per-request shapes the shared
-        # slot batch cannot hold (build_model rejects the combination).
+        # row instead of exactly 1.  Composes with the per-request
+        # prefix cache: hit admissions prefill through the prefixed
+        # wave starts and are recast through ``init_spec_fn`` at
+        # slot-insert time like any other admission (the hit state's
+        # narrower cache pads up to the slot shapes; the drafting
+        # history is host-built from the FULL prompt, prefix included).
         self.spec = bool(
             getattr(cfg, "spec_continuous", False)
             and getattr(engine, "spec_enabled", False)
-            and engine.prefix_cache is None
         )
         if getattr(cfg, "spec_continuous", False) and not self.spec:
             raise ValueError(
                 "SPEC_CONTINUOUS needs SPEC_DECODE=ngram on a spec-capable "
-                "family and PREFIX_CACHE off"
+                "family"
             )
         # Decoder-only families place the prompt at [p_len, p_len+L) of
         # the history (a startup PROMPT_PREFIX occupies [0, p_len) with
@@ -149,7 +151,15 @@ class ContinuousDecodeLoop:
             engine.bundle.params.get("__prefix__")
             if isinstance(engine.bundle.params, dict) else None
         )
-        self._p_len = pre["k"][0].shape[1] if pre is not None else 0
+        if pre is not None:
+            entry = pre["k"][0]
+            # kv_quant stores the global prefix as (int8, scale) tuples.
+            self._p_len = (
+                entry[0].shape[1] if isinstance(entry, tuple)
+                else entry.shape[1]
+            )
+        else:
+            self._p_len = 0
         self._hist_w: int | None = None  # set by _build_empty_state
         self._kv_w: int | None = None
         # Slot count must divide over the replica mesh's batch axis.
@@ -448,12 +458,15 @@ class ContinuousDecodeLoop:
         if not ok:
             return started
         with eng._lock:
-            if eng.prefix_cache is not None and len(ok) > 1:
+            if eng.prefix_cache is not None and (len(ok) > 1 or self.spec):
                 # Grouped wave admission under the per-request prefix
                 # cache: same-(prefix, suffix)-bucket hits batch into
                 # one prefixed start each, misses share one full
                 # prefill wave — a burst of N same-prefix chat
-                # requests pays ~1 prefill dispatch, not N.
+                # requests pays ~1 prefill dispatch, not N.  Spec mode
+                # routes SOLO admissions here too (its insert needs the
+                # collated ids/mask this path threads through, and the
+                # hit/donate bookkeeping is identical either way).
                 return self._admit_prefixed_locked(ok)
             if len(ok) == 1 and not self.spec:
                 for st in ok:
@@ -549,13 +562,16 @@ class ContinuousDecodeLoop:
                 {"input_ids": np.zeros(0, np.int32), "length": np.int32(0)}
             ] * (pad_to - len(feats_list))
 
-        def record(state1, toks, streams):
+        def record(state1, toks, streams, ids=None, mask=None):
+            # ``ids``/``mask`` are the COLLATED (suffix, for hits)
+            # prompt arrays the spec insert feeds to init_spec_fn; the
+            # plain insert ignores them.
             self.prefill_dispatches += 1
             prefetch_to_host(toks, state1.done)
             for row, st in enumerate(streams):
                 row_sampled = float(st.feats.get("temperature", 0.0)) > 0.0
                 started.append(
-                    (st, state1, toks, row_sampled, row, None, None)
+                    (st, state1, toks, row_sampled, row, ids, mask)
                 )
 
         def donate(state1, row, row_ids, L, min_over: int | None):
@@ -587,7 +603,7 @@ class ContinuousDecodeLoop:
             else:
                 for row, (st, row_ids, L) in enumerate(misses):
                     donate(state1, row, row_ids, L, None)
-                record(state1, toks, [st for st, _, _ in misses])
+                record(state1, toks, [st for st, _, _ in misses], ids, mask)
 
         # Hit groups: one batched prefixed start per (prefix, suffix)
         # bucket pair; multi-member groups pad to the slot count so
@@ -622,7 +638,7 @@ class ContinuousDecodeLoop:
                 # Growing conversations keep donating from the hit path
                 # (start_fused's rule, applied per row).
                 donate(state1, row, row_ids, L, pl)
-            record(state1, toks, [st for st, *_ in members])
+            record(state1, toks, [st for st, *_ in members], ids, mask)
         return started
 
     def _admit_complete(self, started: list) -> None:
@@ -1075,9 +1091,10 @@ class ContinuousDecodeLoop:
                             eng.params, pkv, sids, smask, ssp,
                             eng.max_decode_len, eng.chunk_tokens, False,
                         )
-                        self._state = self._insert_fn()(
-                            self._state, st1, np.int32(0), np.int32(0)
-                        )
+                        # Spec mode warms the init_spec_fn-recasting
+                        # insert against the hit-state shape (full
+                        # prompt = prefix + suffix for the hist row).
+                        do_insert(st1, sids, smask, p_len + s_suf)
                     if self.n_slots > 1:
                         wfeats = [sfeats] * self.n_slots
                         with eng._lock:
@@ -1098,9 +1115,7 @@ class ContinuousDecodeLoop:
                                     flag,
                                 )
                                 jax.device_get(tw)
-                            self._state = self._insert_fn()(
-                                self._state, stw, np.int32(0), np.int32(0)
-                            )
+                            do_insert(stw, wids, wmask, p_len + s_suf)
                             # Wave-state donation slicers (growing
                             # conversations donate per row from the
                             # grouped hit state).

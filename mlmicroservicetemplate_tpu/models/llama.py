@@ -172,6 +172,46 @@ def _split(x: jax.Array, n_heads: int) -> jax.Array:
 # prefill
 
 
+def _prefix_entry_len(entry) -> int:
+    """Token count of one prefix K/V entry — dense [1, P, KVH, D] or
+    quantized (int8 payload, scale) tuple."""
+    return entry[0].shape[1] if isinstance(entry, tuple) else entry.shape[1]
+
+
+def _dequant_prefix(entry, dtype):
+    """Dense view of a prefix K/V entry for the prefill-side concat.
+    Quantized entries pay an int8→dtype multiply over P tokens ONCE per
+    prefill — the cache-resident copy stays int8."""
+    if isinstance(entry, tuple):
+        q8, sc = entry
+        return q8.astype(dtype) * sc.astype(dtype)
+    return entry.astype(dtype)
+
+
+def _quant_prefix_entry(entry, dtype):
+    """(int8, scale-in-``dtype``) form of a prefix K/V entry for the
+    quantized cache: already-quantized entries pass through EXACTLY
+    (no requantization loss — capture under kv_quant slices the int8
+    cache rows themselves); dense entries quantize with the cache's own
+    per-token-per-head scheme."""
+    if isinstance(entry, tuple):
+        q8, sc = entry
+        return q8, sc.astype(dtype)
+    q8, sc = kv_quantize(entry)
+    return q8, sc.astype(dtype)
+
+
+def quantize_prefix_kv(pkv: dict) -> dict:
+    """Quantize a dense ``compute_prefix_kv`` pytree to the (int8,
+    scale) entry form the kv_quant cache absorbs — used by the registry
+    to store a global PROMPT_PREFIX at cache width (per-request capture
+    under kv_quant produces this form natively)."""
+    return {
+        "k": [tuple(kv_quantize(k)) for k in pkv["k"]],
+        "v": [tuple(kv_quantize(v)) for v in pkv["v"]],
+    }
+
+
 def forward_hidden(
     params: Params,
     cfg: LlamaConfig,
@@ -188,7 +228,7 @@ def forward_hidden(
     the (already rotated) prefix K/V plus the causal suffix — prefill
     cost is O(S), not O(P+S)."""
     b, s = input_ids.shape
-    p_len = 0 if prefix_kv is None else prefix_kv[0][0].shape[1]
+    p_len = 0 if prefix_kv is None else _prefix_entry_len(prefix_kv[0][0])
     x = embed(params["embed"], input_ids, dtype)
     pos = jnp.arange(p_len, p_len + s, dtype=jnp.int32)
     cos, sin = _rope_tables(cfg, pos, dtype)  # [S, D_h]
@@ -210,12 +250,13 @@ def forward_hidden(
         if collect_kv:
             kv.append((k, v))
         if p_len:
-            pk, pv = prefix_kv[li]
+            pk = _dequant_prefix(prefix_kv[li][0], k.dtype)
+            pv = _dequant_prefix(prefix_kv[li][1], v.dtype)
             k = jnp.concatenate(
-                [jnp.broadcast_to(pk.astype(k.dtype), (b,) + pk.shape[1:]), k], axis=1
+                [jnp.broadcast_to(pk, (b,) + pk.shape[1:]), k], axis=1
             )
             v = jnp.concatenate(
-                [jnp.broadcast_to(pv.astype(v.dtype), (b,) + pv.shape[1:]), v], axis=1
+                [jnp.broadcast_to(pv, (b,) + pv.shape[1:]), v], axis=1
             )
         ctx = mha_attention(
             q, _repeat_kv(k, cfg.n_rep), _repeat_kv(v, cfg.n_rep), mask=mask
@@ -263,7 +304,7 @@ def init_decode_state(
 
     b, s = input_ids.shape
     pre = params.get("__prefix__") if isinstance(params, dict) else None
-    p_len = pre["k"][0].shape[1] if pre is not None else 0
+    p_len = _prefix_entry_len(pre["k"][0]) if pre is not None else 0
     prefix_kv = list(zip(pre["k"], pre["v"])) if pre is not None else None
     total = p_len + s + max_len
     _, kv = forward_hidden(
@@ -271,24 +312,34 @@ def init_decode_state(
         collect_kv=True, prefix_kv=prefix_kv,
     )
     cache_k, cache_v = [], []
-    if cfg.kv_quant and p_len:
-        # The registry rejects the combination; defend here too so a
-        # direct caller never silently mixes dense prefix KV into a
-        # quantized cache.
-        raise ValueError("kv_quant does not compose with cached prefixes")
     for li, (k, v) in enumerate(kv):
         if cfg.kv_quant:
             # Scales stored in the COMPUTE dtype: the decode step
             # recovers its working dtype from the state (the int8
             # payload can't carry it), and mha_attention_kv8 upcasts
-            # scales into the f32 logits anyway.
+            # scales into the f32 logits anyway.  Prefix rows (global
+            # PROMPT_PREFIX or a per-request cache hit) land as int8 +
+            # scale too — already-quantized entries copy bit-exact,
+            # dense ones quantize with the cache's own scheme — so the
+            # whole slab stays uniform for the fused decode kernel.
             shape = (b, total, cfg.num_kv_heads, cfg.head_dim)
             k8, ks = kv_quantize(k)
             v8, vs = kv_quantize(v)
-            ck8 = jnp.zeros(shape, jnp.int8).at[:, :s].set(k8)
-            cks = jnp.ones(shape[:3] + (1,), dtype).at[:, :s].set(ks.astype(dtype))
-            cv8 = jnp.zeros(shape, jnp.int8).at[:, :s].set(v8)
-            cvs = jnp.ones(shape[:3] + (1,), dtype).at[:, :s].set(vs.astype(dtype))
+            ck8 = jnp.zeros(shape, jnp.int8)
+            cks = jnp.ones(shape[:3] + (1,), dtype)
+            cv8 = jnp.zeros(shape, jnp.int8)
+            cvs = jnp.ones(shape[:3] + (1,), dtype)
+            if p_len:
+                pk8, pks = _quant_prefix_entry(prefix_kv[li][0], dtype)
+                pv8, pvs = _quant_prefix_entry(prefix_kv[li][1], dtype)
+                ck8 = ck8.at[:, :p_len].set(pk8)
+                cks = cks.at[:, :p_len].set(pks)
+                cv8 = cv8.at[:, :p_len].set(pv8)
+                cvs = cvs.at[:, :p_len].set(pvs)
+            ck8 = ck8.at[:, p_len : p_len + s].set(k8)
+            cks = cks.at[:, p_len : p_len + s].set(ks.astype(dtype))
+            cv8 = cv8.at[:, p_len : p_len + s].set(v8)
+            cvs = cvs.at[:, p_len : p_len + s].set(vs.astype(dtype))
             cache_k.append((ck8, cks))
             cache_v.append((cv8, cvs))
             continue

@@ -659,15 +659,24 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
 
         from ..ops.attention import decode_kernel_fits
 
-        # Worst-case cache width this deployment can reach (QUANT_KV
-        # excludes cached prefixes, so p_len = 0): largest prompt
-        # bucket + the chunk-rounded decode budget.
-        chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
-        t_est = max(svc_cfg.seq_buckets) + int(
-            _math.ceil(svc_cfg.max_decode_len / chunk) * chunk
-        )
+        # Worst-case cache width this deployment can reach.  The
+        # per-request prefix cache never widens it (its admission guard
+        # keeps p_len + suffix bucket <= the max seq bucket), but a
+        # global PROMPT_PREFIX prepends its own tokens — estimate them
+        # with the request tokenizer (upper bound: terminal specials
+        # not yet stripped) so the VMEM-fit gate sees the real slab.
         probe = llama_mod.LlamaConfig(
             **{k: v for k, v in overrides.items() if k != "pallas_decode"}
+        )
+        p_est = 0
+        if getattr(svc_cfg, "prompt_prefix", None):
+            _, _pmask = tokenizer.encode(
+                svc_cfg.prompt_prefix, probe.max_position
+            )
+            p_est = int(_pmask.sum())
+        chunk = max(1, int(getattr(svc_cfg, "stream_chunk_tokens", 4)))
+        t_est = p_est + max(svc_cfg.seq_buckets) + int(
+            _math.ceil(svc_cfg.max_decode_len / chunk) * chunk
         )
         try:
             on_tpu = _jax.default_backend() == "tpu"
@@ -712,6 +721,16 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         ),
         cfg.max_position,
     )
+    if p_len and cfg.kv_quant:
+        # The quantized cache stores every row as int8 + per-token
+        # scale, the global prefix included: quantize it ONCE here
+        # (startup), so init_decode_state writes prefix rows at int8
+        # width and the fused Pallas decode kernel reads one uniform
+        # int8 slab.  The prefill-side attention over the prefix
+        # dequantizes these few rows per request (llama.forward_hidden).
+        params["__prefix__"] = llama_mod.quantize_prefix_kv(
+            params["__prefix__"]
+        )
     if p_len and getattr(tokenizer, "add_bos", False):
         tokenizer.add_bos = False
 
@@ -832,30 +851,24 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
             "gpt2, llama, t5-small)"
         )
     if getattr(svc_cfg, "quant_kv", None):
+        # QUANT_KV now COMPOSES with both prefix knobs (round-6): prefix
+        # KV is captured/attached as int8+per-row-scale entries the
+        # quantized cache absorbs directly (llama._quant_prefix_entry),
+        # so the only retained restriction is the family one.
         if bundle.name != "llama":
             raise ValueError(
                 f"QUANT_KV is not supported for {svc_cfg.model_name!r} "
                 "(int8 KV cache covers the llama family)"
             )
-        if getattr(svc_cfg, "prefix_cache", False) or getattr(
-            svc_cfg, "prompt_prefix", None
-        ):
-            raise ValueError(
-                "QUANT_KV does not compose with prefix caching: cached "
-                "prefixes carry dense bf16 KV that a quantized cache "
-                "cannot absorb (pick one lever per deployment)"
-            )
     if getattr(svc_cfg, "spec_continuous", False):
+        # PREFIX_CACHE no longer excluded (round-6): hit-group batched
+        # wave states recast through init_spec_fn at slot-insert time
+        # (engine/streams.py), so prefix-hit streams join the spec slot
+        # batch like any other admission.
         if not getattr(svc_cfg, "spec_decode", None):
             raise ValueError(
                 "SPEC_CONTINUOUS requires SPEC_DECODE=ngram (it is the "
                 "continuous-loop extension of speculative decoding)"
-            )
-        if getattr(svc_cfg, "prefix_cache", False):
-            raise ValueError(
-                "SPEC_CONTINUOUS and PREFIX_CACHE are mutually exclusive: "
-                "cache hits prefill at per-request shapes the shared "
-                "slot batch cannot hold (pick one lever per deployment)"
             )
     if getattr(svc_cfg, "prefix_cache", False):
         if not bundle.supports_prefix:

@@ -185,8 +185,11 @@ def decode_attention(
     Grid (B,): each program serves one batch row — its whole KV slab
     crosses HBM once (the XLA path's ``_repeat_kv`` costs one read per
     query head), and with ``k_scale``/``v_scale`` the payload crosses
-    at int8 width with in-kernel dequant.  VMEM: the [T, KVH, D] slab
-    + f32 copies ~= 4.6 MB at T=2048, KVH=4, D=64 — comfortable."""
+    at int8 width with in-kernel dequant.  The kernel never cares where
+    rows came from: cached prefixes (PREFIX_CACHE / PROMPT_PREFIX under
+    QUANT_KV) are written into the slab as int8 + scale like prefill
+    rows, so prefix hits ride through unchanged.  VMEM: the [T, KVH, D]
+    slab + f32 copies ~= 4.6 MB at T=2048, KVH=4, D=64 — comfortable."""
     from jax.experimental import pallas as pl
 
     b, h, d = q.shape

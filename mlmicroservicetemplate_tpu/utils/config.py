@@ -111,7 +111,9 @@ class ServiceConfig(BaseModel):
     # per-token-per-head int8 + scales, halving the SECOND bandwidth
     # term of batched long-context decode (weights being the first).
     # Lossy (not bit-identical to bf16-cache generation); measured in
-    # BASELINE.md.  Mutually exclusive with prefix caching.
+    # BASELINE.md.  Composes with both prefix knobs (round 6): cached
+    # prefix rows are captured/attached as int8 + scale entries the
+    # quantized cache absorbs directly.
     quant_kv: str | None = None
 
     # Speculative decoding for generative families (gpt2/llama/t5):
@@ -142,10 +144,12 @@ class ServiceConfig(BaseModel):
     # round — wins on quoting/repetitive traffic, can lose on
     # low-acceptance traffic at high width (measure before enabling:
     # benchmarks/streams_scaling.py prints the spec_continuous column
-    # by default; BENCH_SPEC=0 skips it).  Requires PREFIX_CACHE off
-    # (hit states have per-request shapes the shared slot batch cannot
-    # hold).  With SPEC_SAMPLED=0, sampled streams bypass the loop to
-    # the per-stream chunked path so the strict seed contract holds.
+    # by default; BENCH_SPEC=0 skips it).  Stacks with PREFIX_CACHE
+    # (round 6): hit admissions recast through init_spec_fn at
+    # slot-insert time, so prefix-hit streams join the spec slot
+    # batch (benchmarks/compose_ab.py measures the stack).  With
+    # SPEC_SAMPLED=0, sampled streams bypass the loop to the
+    # per-stream chunked path so the strict seed contract holds.
     spec_continuous: bool = False
     # Rejection-sampling acceptance for temperature>0 requests (accept
     # draft_i with prob p(draft_i) under the filtered distribution;

@@ -97,10 +97,24 @@ def test_quant_kv_registry_guards():
         build_model(ServiceConfig(
             device="cpu", model_name="gpt2", quant_kv="int8"
         ))
-    with pytest.raises(ValueError, match="does not compose"):
-        build_model(ServiceConfig(
+    # QUANT_KV × PREFIX_CACHE composes since round 6 (quantized prefix
+    # capture) — the composed-config acceptance lives in
+    # tests/test_compose.py; here just assert no ValueError.
+    import os as _os
+
+    _os.environ["LLAMA_CONFIG"] = (
+        '{"vocab_size": 300, "d_model": 32, "num_heads": 4, '
+        '"num_kv_heads": 2, "num_layers": 2, "d_ff": 64, '
+        '"max_position": 256}'
+    )
+    try:
+        bundle = build_model(ServiceConfig(
             device="cpu", model_name="llama", quant_kv="int8",
-            prefix_cache=True,
+            prefix_cache=True, warmup=False, seq_buckets=(16, 32),
+            max_decode_len=16,
         ))
+        assert bundle.cfg.kv_quant
+    finally:
+        _os.environ.pop("LLAMA_CONFIG", None)
     with pytest.raises(ValueError, match="QUANT_KV must be"):
         ServiceConfig(device="cpu", quant_kv="int4")
