@@ -150,7 +150,7 @@ def measure(cdl, prompts: list[np.ndarray], n: int) -> dict:
     # reset the counter to the drained truth so later widths aren't
     # shed by leaked admissions.
     deadline = time.monotonic() + 30
-    while (cdl.active or not cdl.pending.empty()) and time.monotonic() < deadline:
+    while (cdl.active or cdl.queue.qsize() > 0) and time.monotonic() < deadline:
         time.sleep(0.01)
     cdl._admitted = 0
     return {

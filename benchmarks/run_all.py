@@ -105,11 +105,20 @@ async def main() -> None:
     # PREFIX_CACHE × SPEC_CONTINUOUS × QUANT_KV llama deployment vs
     # each single lever, in a subprocess so its five engine builds
     # can't disturb the table above.  COMPOSE_AB=0 skips.
-    if os.environ.get("COMPOSE_AB", "1").lower() not in ("0", "false", "no"):
-        import subprocess
+    import subprocess
 
+    if os.environ.get("COMPOSE_AB", "1").lower() not in ("0", "false", "no"):
         subprocess.run(
             [sys.executable, os.path.join(_here, "compose_ab.py")],
+            check=False,
+        )
+
+    # SLA scheduler under overload (round-7 tentpole): interactive
+    # goodput + p99 TTFT at 1×/2×/4× offered load, FIFO baseline vs
+    # priority/deadline headers.  OVERLOAD_AB=0 skips.
+    if os.environ.get("OVERLOAD_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "overload_ab.py")],
             check=False,
         )
 

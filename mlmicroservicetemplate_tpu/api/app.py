@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import os
 import time
 
@@ -20,7 +21,7 @@ import numpy as np
 from aiohttp import web
 
 from ..models.registry import KIND_SEQ2SEQ, ModelBundle, RawItem
-from ..scheduler import Batcher, QueueFullError
+from ..scheduler import Batcher, DeadlineExceededError, QueueFullError
 from ..utils import metrics
 
 log = logging.getLogger(__name__)
@@ -155,6 +156,51 @@ async def _on_cleanup(app: web.Application) -> None:
 
 
 # ---------------------------------------------------------------------------
+# scheduling headers / shed responses
+
+
+def _sched_fields(request: web.Request) -> dict:
+    """X-Priority / X-Deadline-Ms headers → the scheduling fields the
+    admission controller reads off the feats dict.  Malformed headers
+    are client errors (400), not silently-defaulted surprises."""
+    out: dict = {}
+    p = request.headers.get("X-Priority")
+    if p is not None:
+        p = p.strip().lower()
+        if p not in ("interactive", "batch"):
+            raise web.HTTPBadRequest(
+                reason='X-Priority must be "interactive" or "batch"'
+            )
+        out["priority"] = p
+    d = request.headers.get("X-Deadline-Ms")
+    if d is not None:
+        try:
+            dv = float(d)
+        except ValueError:
+            raise web.HTTPBadRequest(reason="X-Deadline-Ms must be a number")
+        if not dv > 0:  # also rejects NaN
+            raise web.HTTPBadRequest(reason="X-Deadline-Ms must be > 0")
+        out["deadline_ms"] = dv
+    return out
+
+
+def _shed_response(e: QueueFullError) -> web.HTTPServiceUnavailable:
+    """503 with Retry-After derived from queue depth × observed batch
+    latency (the batcher stamps retry_after_s on the error)."""
+    ra = max(1, int(math.ceil(getattr(e, "retry_after_s", None) or 1.0)))
+    return web.HTTPServiceUnavailable(
+        reason=str(e) or "overloaded, retry later",
+        headers={"Retry-After": str(ra)},
+    )
+
+
+def _deadline_response() -> web.HTTPGatewayTimeout:
+    return web.HTTPGatewayTimeout(
+        reason="deadline passed before dispatch; request shed"
+    )
+
+
+# ---------------------------------------------------------------------------
 # /predict
 
 
@@ -244,6 +290,7 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
     t0 = time.monotonic()
     try:
         item = await _parse_request(request)
+        sched = _sched_fields(request)
     except web.HTTPBadRequest:
         # Parse-level 400s must show up in /metrics like every other
         # terminal status — error rates are an observability surface.
@@ -258,6 +305,7 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
         # OSError covers PIL's UnidentifiedImageError on corrupt bytes.
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=str(e) or "undecodable payload")
+    feats.update(sched)
 
     if stream and bundle.kind == KIND_SEQ2SEQ:
         return await _stream_predict(request, feats, t0, item)
@@ -273,9 +321,12 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
             result["prediction"]["text"] = _apply_stop(
                 result["prediction"]["text"], item.stop
             )
-    except QueueFullError:
+    except QueueFullError as e:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise web.HTTPServiceUnavailable(reason="batch queue full, retry later")
+        raise _shed_response(e)
+    except DeadlineExceededError:
+        metrics.REQUESTS.labels(bundle.name, "504").inc()
+        raise _deadline_response()
     except Exception:
         # Engine/dispatch failure: surface as a clean 500 (with a metric
         # and a server-side traceback), not an opaque aiohttp error page.
@@ -408,17 +459,56 @@ async def _delta_stream(bundle: ModelBundle, stream_iter, item: RawItem):
     }
 
 
+async def _open_stream(app, bundle: ModelBundle, feats: dict, item: RawItem,
+                       t0: float):
+    """Open a stream and pull its FIRST event before any response bytes
+    go out: a stream that queued under the scheduler and was then shed
+    (evicted → 503, expired deadline → 504, drain → 503) still maps to
+    a real HTTP status instead of a broken 200 body.  Also the TTFT
+    observation point.  Returns (event_iterator, stream_iter)."""
+    from ..engine.streams import StreamClosedError
+
+    try:
+        stream_iter = app[K_BATCHER].submit_stream(feats)
+    except QueueFullError as e:
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise _shed_response(e)
+    events = _delta_stream(bundle, stream_iter, item)
+    try:
+        first = await events.__anext__()
+    except QueueFullError as e:
+        await stream_iter.aclose()
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise _shed_response(e)
+    except DeadlineExceededError:
+        await stream_iter.aclose()
+        metrics.REQUESTS.labels(bundle.name, "504").inc()
+        raise _deadline_response()
+    except StreamClosedError as e:
+        await stream_iter.aclose()
+        metrics.REQUESTS.labels(bundle.name, "503").inc()
+        raise _shed_response(QueueFullError(str(e), reason="drain"))
+    except StopAsyncIteration:
+        # _delta_stream always yields a final event; defensive.
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        raise web.HTTPInternalServerError(reason="stream produced no events")
+    metrics.TTFT.labels(bundle.name).observe(time.monotonic() - t0)
+
+    async def chained():
+        yield first
+        async for ev in events:
+            yield ev
+
+    return chained(), stream_iter
+
+
 async def _stream_predict(
     request: web.Request, feats: dict, t0: float, item: RawItem
 ) -> web.StreamResponse:
     """Chunked seq2seq streaming: ndjson lines of decoded-token deltas."""
     app = request.app
     bundle: ModelBundle = app[K_BUNDLE]
-    try:
-        stream_iter = app[K_BATCHER].submit_stream(feats)
-    except QueueFullError:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise web.HTTPServiceUnavailable(reason="too many active streams, retry later")
+    events, stream_iter = await _open_stream(app, bundle, feats, item, t0)
     resp = web.StreamResponse(
         status=200,
         headers={"Content-Type": "application/x-ndjson", "X-Accel-Buffering": "no"},
@@ -431,7 +521,7 @@ async def _stream_predict(
         # `cancelled` now, not whenever GC finalizes the generator; an
         # abandoned stream must stop dispatching device chunks at the
         # next boundary.
-        async for ev in _delta_stream(bundle, stream_iter, item):
+        async for ev in events:
             if "delta" in ev:
                 # One line per device chunk even when the decoded delta
                 # is empty: clients get progress at chunk cadence.
@@ -521,9 +611,12 @@ async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
             or full_len <= item.max_tokens
         ) else "length"
         return text, finish, n_tok
-    except QueueFullError:
+    except QueueFullError as e:
         metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise web.HTTPServiceUnavailable(reason="queue full, retry later")
+        raise _shed_response(e)
+    except DeadlineExceededError:
+        metrics.REQUESTS.labels(bundle.name, "504").inc()
+        raise _deadline_response()
     except Exception:
         metrics.REQUESTS.labels(bundle.name, "500").inc()
         log.exception("completion failed")
@@ -588,12 +681,18 @@ async def _openai_prologue(request: web.Request, to_prompt):
     except web.HTTPBadRequest:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise
+    try:
+        sched = _sched_fields(request)
+    except web.HTTPBadRequest:
+        metrics.REQUESTS.labels(bundle.name, "400").inc()
+        raise
     loop = asyncio.get_running_loop()
     try:
         feats = await loop.run_in_executor(None, bundle.preprocess, item)
     except (ValueError, OSError) as e:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=str(e) or "bad request")
+    feats.update(sched)
     # OpenAI stream semantics: usage appears in a stream ONLY when the
     # client asked via stream_options.include_usage (then every chunk
     # carries "usage": null and one extra final chunk carries the
@@ -611,16 +710,13 @@ def _sse_frame(payload: dict) -> bytes:
 
 async def _sse_stream(request, feats, item, t0, events, preamble=None):
     """Shared SSE scaffolding for both /v1 streaming endpoints:
-    503 shedding, headers, the _delta_stream loop, [DONE], metrics and
-    cleanup.  ``events(ev) -> list[bytes]`` shapes each delta/final
-    event; ``preamble`` is written first (chat's role chunk)."""
+    503/504 shedding (with Retry-After), headers, the _delta_stream
+    loop, [DONE], metrics and cleanup.  ``events(ev) -> list[bytes]``
+    shapes each delta/final event; ``preamble`` is written first
+    (chat's role chunk)."""
     app = request.app
     bundle: ModelBundle = app[K_BUNDLE]
-    try:
-        stream_iter = app[K_BATCHER].submit_stream(feats)
-    except QueueFullError:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise web.HTTPServiceUnavailable(reason="too many active streams")
+    ev_iter, stream_iter = await _open_stream(app, bundle, feats, item, t0)
     resp = web.StreamResponse(
         status=200,
         headers={"Content-Type": "text/event-stream",
@@ -631,7 +727,7 @@ async def _sse_stream(request, feats, item, t0, events, preamble=None):
     try:
         if preamble is not None:
             await resp.write(preamble)
-        async for ev in _delta_stream(bundle, stream_iter, item):
+        async for ev in ev_iter:
             for frame in events(ev):
                 await resp.write(frame)
             if ev.get("done"):
@@ -797,10 +893,19 @@ async def handle_models(request: web.Request) -> web.Response:
 
 
 async def handle_healthz(request: web.Request) -> web.Response:
-    return web.json_response({"alive": True})
+    """Liveness: stays 200 through drain (the process is healthy; it
+    just stopped taking work) — only readiness flips."""
+    return web.json_response(
+        {"alive": True, "draining": request.app[K_BATCHER].draining}
+    )
 
 
 async def handle_readyz(request: web.Request) -> web.Response:
+    if request.app[K_BATCHER].draining:
+        # Load balancers stop routing here while in-flight work drains.
+        return web.json_response(
+            {"ready": False, "draining": True}, status=503
+        )
     if request.app[K_READY].is_set():
         return web.json_response({"ready": True})
     body = {"ready": False}
@@ -808,6 +913,26 @@ async def handle_readyz(request: web.Request) -> web.Response:
     if err:
         body["error"] = err
     return web.json_response(body, status=503)
+
+
+async def drain_app(app: web.Application, grace_s: float = 30.0) -> bool:
+    """SIGTERM drain choreography: stop admitting (readyz → 503 so the
+    LB stops routing; new requests shed 503 ``drain`` + Retry-After),
+    then wait for everything already admitted — queued batches AND
+    in-flight streams — up to ``grace_s``.  Returns True when fully
+    drained.  serve.py calls this between the signal and process exit;
+    tests call it directly."""
+    batcher: Batcher = app[K_BATCHER]
+    batcher.begin_drain()
+    ok = await batcher.drained(grace_s)
+    if ok:
+        log.info("drain complete: all in-flight work finished")
+    else:
+        log.warning(
+            "drain grace (%.0fs) expired with %d work items outstanding",
+            grace_s, batcher.pending_work(),
+        )
+    return ok
 
 
 async def handle_status(request: web.Request) -> web.Response:
@@ -835,6 +960,13 @@ async def handle_status(request: web.Request) -> web.Response:
             if app[K_STATE]["warmup_s"] is not None
             else None
         ),
+    }
+    batcher = app[K_BATCHER]
+    body["scheduler"] = {
+        "draining": batcher.draining,
+        "queue_depth": batcher._queue.qsize(),
+        "kv_committed_bytes": batcher.admission.committed_bytes,
+        "kv_budget_bytes": batcher.admission.kv_budget_bytes,
     }
     err = app[K_STATE]["ready_error"]
     if err:

@@ -380,6 +380,52 @@ class InferenceEngine:
             int(feats.get("max_tokens", self.max_decode_len)), self.max_decode_len
         )
 
+    def kv_bytes_estimate(self, feats: dict) -> int:
+        """Admission-time estimate of one request's KV-cache footprint
+        in bytes: padded prompt bucket + server decode budget wide,
+        model dims off the bundle config, element width off the active
+        QUANT_KV mode (int8 payload + one f32 scale per token-head vs
+        the compute dtype).  Encoder-decoder families add the
+        cross-attention cache over the encoder bucket.
+
+        Deliberately a ceiling (collation pads up to buckets, the full
+        decode budget is reserved even if the row EOSes early), so the
+        scheduler's HBM budget fails SAFE — overcommit is refused at
+        admission instead of discovered at slot-insert."""
+        if self.bundle.kind != KIND_SEQ2SEQ:
+            return 0
+        cfg = self.bundle.cfg
+        s = bucket_for(
+            max(int(feats.get("length", 0) or 0), 1),
+            self.seq_buckets, self.replicas.seq_multiple(),
+        )
+        width = s + self.max_decode_len
+        layers = int(getattr(cfg, "num_layers", 0) or 12)
+        heads = int(
+            getattr(cfg, "num_kv_heads", 0)
+            or getattr(cfg, "num_heads", 0) or 12
+        )
+        head_dim = getattr(cfg, "d_kv", None) or getattr(
+            cfg, "head_dim", None
+        )
+        if head_dim is None:
+            d_model = int(getattr(cfg, "d_model", 0) or 768)
+            n_attn = int(getattr(cfg, "num_heads", 0) or heads)
+            head_dim = max(1, d_model // max(1, n_attn))
+        if getattr(self.cfg, "quant_kv", None) == "int8":
+            per_tok_head = int(head_dim) * 1 + 4  # int8 + f32 scale
+        else:
+            try:
+                elt = np.dtype(self.bundle.policy.compute_jnp).itemsize
+            except Exception:
+                elt = 2
+            per_tok_head = int(head_dim) * elt
+        total = 2 * layers * heads * width * per_tok_head
+        if getattr(cfg, "d_kv", None) is not None:
+            # Encoder-decoder: cross-attention K/V over the encoder seq.
+            total += 2 * layers * heads * s * per_tok_head
+        return int(total)
+
     def _collate_budget(self, feats: list[dict], bsz: int) -> np.ndarray:
         """Per-row budgets for the batched non-stream path; pad rows 0."""
         budgets = np.zeros(bsz, np.int32)

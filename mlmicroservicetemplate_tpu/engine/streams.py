@@ -51,7 +51,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import queue as queue_mod
 import threading
 import time
 from typing import Any, AsyncIterator
@@ -82,10 +81,20 @@ class StreamClosedError(Exception):
 
 
 class _Stream:
-    """One client stream: thread-safe bridge loop-thread → event loop."""
+    """One client stream: thread-safe bridge loop-thread → event loop.
+
+    Doubles as the deadline queue's scheduled item (``klass`` /
+    ``deadline`` / ``started`` / ``_removed``) and, under preemption,
+    as its own checkpoint: ``tokens`` records every token DELIVERED to
+    the consumer, so a preempted stream can resume token-identically —
+    either by re-prefilling prompt+delivered (decoder-only causal LMs)
+    or by replaying the whole deterministic generation with the first
+    ``skip`` tokens suppressed."""
 
     __slots__ = (
-        "feats", "chunks", "loop", "cancelled", "produced", "released", "budget",
+        "feats", "chunks", "loop", "cancelled", "produced", "released",
+        "budget", "klass", "deadline", "started", "kv", "kv_held",
+        "skip", "tokens", "preempted", "t_in", "_removed",
     )
 
     def __init__(self, feats: dict, loop: asyncio.AbstractEventLoop,
@@ -100,6 +109,19 @@ class _Stream:
         # decode budget): the loop stops spending chunks on this row
         # once reached; the API layer trims to the exact count.
         self.budget = budget
+        # Scheduling fields (set by submit_stream from the admission
+        # controller; defaults = the seed's behavior).
+        self.klass = "interactive"
+        self.deadline: float | None = None
+        self.started = False
+        self.kv = 0
+        self.kv_held = False
+        # Preemption checkpoint state.
+        self.skip = 0
+        self.tokens: list[int] = []
+        self.preempted = 0
+        self.t_in = time.monotonic()
+        self._removed = False
 
     def emit(self, item: Any) -> None:
         try:
@@ -165,7 +187,27 @@ class ContinuousDecodeLoop:
         # Slot count must divide over the replica mesh's batch axis.
         mult = engine.replicas.pad_multiple()
         self.n_slots = -(-self.max_streams // mult) * mult
-        self.pending: queue_mod.Queue = queue_mod.Queue()
+        # SLA scheduling (scheduler/policy.py): the old unbounded
+        # handoff Queue + instant reject past max_streams is now a
+        # BOUNDED deadline-aware wait queue — up to ``max_stream_queue``
+        # streams wait (EDF within class, class-weighted across) beyond
+        # the active slots; 0 keeps the historical instant-503 contract.
+        from ..scheduler.policy import DeadlineQueue
+
+        self.max_stream_queue = max(
+            0, int(getattr(cfg, "max_stream_queue", 0))
+        )
+        self.queue = DeadlineQueue(
+            self.max_streams + self.max_stream_queue,
+            weight=int(getattr(cfg, "class_weight", 4)),
+        )
+        # Shared AdmissionController (set by the Batcher; None when the
+        # loop is driven directly, e.g. in tests — defaults apply).
+        self.admission = None
+        # Interactive arrivals may preempt batch-class slot holders.
+        self.preempt = bool(getattr(cfg, "preempt", True))
+        self.preemptions = 0  # observability + test hook
+        self._stream_ewma_s = 1.0
         self.active: dict[int, _Stream] = {}
         self.sampled_slots: set[int] = set()
         self.free: list[int] = list(range(self.n_slots))
@@ -227,22 +269,47 @@ class ContinuousDecodeLoop:
     def submit_stream(self, feats: dict) -> AsyncIterator[np.ndarray]:
         """Admission-checked stream entry; mirrors Batcher.submit_stream.
 
-        Raises ``QueueFullError`` past ``max_streams`` concurrent
-        streams (counting pending ones)."""
-        from ..scheduler.batcher import QueueFullError
+        Sheds with ``QueueFullError`` once ``max_streams`` active plus
+        ``max_stream_queue`` waiting streams exist — unless the
+        newcomer outranks a waiter (lower class or later deadline),
+        which is then shed in its place.  A queued stream whose
+        deadline passes before its first dispatch fails with
+        ``DeadlineExceededError`` (the API maps it to 504)."""
+        from ..scheduler.policy import QueueFullError
 
         if self._stop.is_set():
             raise RuntimeError("decode loop is stopped")
-        total = self._admitted + int(self.external_active())
-        if total >= self.max_streams:
-            raise QueueFullError(
-                f"{total} streams active >= max_streams={self.max_streams}"
-            )
-        self._admitted += 1
+        adm = self.admission
         st = _Stream(
             feats, asyncio.get_running_loop(), self.engine.budget_for(feats)
         )
-        self.pending.put(st)
+        if adm is not None:
+            klass, deadline = adm.classify(feats)
+            try:
+                klass, kv = adm.admit(feats, klass)
+            except QueueFullError as e:
+                if e.retry_after_s is None:
+                    e.retry_after_s = self._retry_after_s()
+                self._shed(e.reason)
+                raise
+            st.klass, st.deadline, st.kv = klass, deadline, kv
+        total = self._admitted + int(self.external_active())
+        if total >= self.max_streams + self.max_stream_queue:
+            victim = self.queue.evict_for(st)
+            if victim is None:
+                self._shed("queue_full")
+                raise QueueFullError(
+                    f"{total} streams active >= max_streams="
+                    f"{self.max_streams}+{self.max_stream_queue} queued",
+                    retry_after_s=self._retry_after_s(),
+                )
+            self._shed("queue_full")
+            self._finish(victim, QueueFullError(
+                "shed for higher-priority stream",
+                retry_after_s=self._retry_after_s(),
+            ))
+        self._admitted += 1
+        self.queue.put(st, force=True)  # bound enforced just above
         self._ensure_thread()
 
         async def gen():
@@ -282,9 +349,14 @@ class ContinuousDecodeLoop:
             t.join(timeout=30)
 
     def _release(self, st: _Stream) -> None:
-        """Exactly-once per stream, loop-thread only."""
+        """Exactly-once per stream (loop thread, or the event loop for
+        a stream that never reached the loop thread)."""
         if not st.released:
             st.released = True
+            if self.admission is not None:
+                self.admission.release(st)
+            dt = time.monotonic() - st.t_in
+            self._stream_ewma_s = 0.8 * self._stream_ewma_s + 0.2 * dt
             try:
                 st.loop.call_soon_threadsafe(self._dec_admitted)
             except RuntimeError:
@@ -292,6 +364,30 @@ class ContinuousDecodeLoop:
                 # with the loop; decrement directly so a restarted
                 # consumer-side view stays sane.
                 self._admitted -= 1
+
+    def _shed(self, reason: str) -> None:
+        metrics.SHED.labels(self.engine.bundle.name, reason).inc()
+
+    def _retry_after_s(self) -> float:
+        est = (self._admitted + 1) * self._stream_ewma_s / max(
+            1, self.max_streams
+        )
+        return min(60.0, max(1.0, est))
+
+    def _fits(self, st: _Stream) -> bool:
+        return self.admission is None or self.admission.fits(st)
+
+    def _reserve(self, st: _Stream) -> None:
+        if self.admission is not None:
+            self.admission.reserve(st)
+
+    def _class_gauges(self) -> None:
+        from ..scheduler.policy import BATCH, INTERACTIVE
+
+        for klass in (INTERACTIVE, BATCH):
+            metrics.CLASS_QUEUE_DEPTH.labels(
+                self.engine.bundle.name, "stream", klass
+            ).set(self.queue.waiting(klass))
 
     def _finish(self, st: _Stream, item: Any = _END) -> None:
         st.emit(item)
@@ -308,27 +404,42 @@ class ContinuousDecodeLoop:
         log.info("continuous decode loop up: %d slots", self.n_slots)
         while not self._stop.is_set():
             try:
+                # Stale waiters shed as fast 504s BEFORE any admission
+                # work — never prefill a request nobody is waiting for.
+                self._expire_queued()
                 if (
                     not self.active
                     and not self._inflight_chunks
-                    and self.pending.empty()
+                    and self.queue.qsize() == 0
                 ):
-                    try:
-                        st = self.pending.get(timeout=0.05)
-                    except queue_mod.Empty:
+                    st = self.queue.pop(timeout=0.05, fits=self._fits)
+                    if st is None:
                         continue
+                    self._reserve(st)
                     wave = [st]
                 else:
                     wave = []
+                # Interactive work waiting with every slot busy: at
+                # this chunk boundary, checkpoint batch-class slot
+                # holders and re-queue them so the wave below can admit
+                # the interactive arrivals instead of shedding them.
+                if (
+                    self.preempt
+                    and not wave
+                    and not self.free
+                    and self.queue.waiting("interactive") > 0
+                ):
+                    self._preempt_for_interactive()
                 # Chunk boundary: admit everything that fits, as ONE
                 # wave — N prefill dispatches queue on the device and a
                 # single combined transfer fetches all their first
                 # chunks, so a wave costs one round-trip, not N.
-                while (
-                    len(wave) + len(self.active) < self.n_slots
-                    and not self.pending.empty()
-                ):
-                    wave.append(self.pending.get_nowait())
+                while len(wave) + len(self.active) < self.n_slots:
+                    st = self.queue.pop_nowait(fits=self._fits)
+                    if st is None:
+                        break
+                    self._reserve(st)
+                    wave.append(st)
                 # Cold-burst debounce: a concurrent burst's streams land
                 # on the queue microseconds apart, but the loop thread
                 # can outrace the submitting thread and admit a partial
@@ -344,10 +455,14 @@ class ContinuousDecodeLoop:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
-                        try:
-                            wave.append(self.pending.get(timeout=remaining))
-                        except queue_mod.Empty:
+                        st = self.queue.pop(
+                            timeout=remaining, fits=self._fits
+                        )
+                        if st is None:
                             break
+                        self._reserve(st)
+                        wave.append(st)
+                self._class_gauges()
                 if wave and not self.overlap_admission:
                     # Round-3 blocking order, kept for A/B
                     # (ADMIT_OVERLAP=0): prefill + fetch + insert all
@@ -393,6 +508,10 @@ class ContinuousDecodeLoop:
                     # stream tail — the dominant cost at short decode
                     # budgets).
                     self._deliver_all()
+                elif not dispatched and not wave and not self.active:
+                    # Waiters exist but none fit the KV budget (no
+                    # admission, no work in flight): poll, don't spin.
+                    time.sleep(0.01)
             except Exception as e:  # pragma: no cover - defensive
                 log.exception("decode loop iteration failed")
                 for st, *_ in self._pending_admissions:
@@ -412,16 +531,135 @@ class ContinuousDecodeLoop:
                 self._inflight_chunks.clear()
                 self.sampled_slots.clear()
         # Shutdown: end every remaining consumer cleanly.
-        while not self.pending.empty():
-            try:
-                self._finish(self.pending.get_nowait(), StreamClosedError("server stopping"))
-            except queue_mod.Empty:  # pragma: no cover
-                break
+        for st in self.queue.drain_all():
+            self._finish(st, StreamClosedError("server stopping"))
         for slot in list(self.active):
             st = self.active.get(slot)
             if st is not None:
                 st.emit(StreamClosedError("server stopping"))
             self._free_slot(slot)
+
+    def _expire_queued(self) -> None:
+        """Fail every queued stream whose deadline passed while it
+        waited — the consumer raises before any response bytes went
+        out, so the API layer returns a real 504."""
+        from ..scheduler.policy import DeadlineExceededError
+
+        for st in self.queue.expire():
+            self._shed("deadline")
+            self._finish(st, DeadlineExceededError(
+                "deadline passed while queued; stream shed before dispatch"
+            ))
+
+    # -- preemption ----------------------------------------------------
+
+    def _preempt_for_interactive(self) -> None:
+        """Interactive work is waiting and every slot is busy: evict
+        batch-class slot holders (latest deadline first) at this chunk
+        boundary.  The victim's checkpoint is its delivery cursor —
+        the tokens the consumer already received — and it re-queues
+        (``started``: exempt from expiry/eviction) for resumption when
+        capacity returns; its consumer never sees the gap."""
+        # Anti-thrash guard: while a checkpointed stream still waits to
+        # resume, interactive arrivals rely on the class-weighted queue
+        # instead of evicting MORE batch work — every preemption
+        # discards that stream's in-flight compute, so unbounded
+        # preemption under sustained overload melts total throughput
+        # without helping the interactive class.
+        if self.queue.waiting_started() > 0:
+            return
+        want = min(self.queue.waiting("interactive"), self.n_slots)
+        victims = [
+            (slot, st)
+            for slot, st in self.active.items()
+            if st.klass == "batch"
+            and not st.cancelled.is_set()
+            and st.preempted < 2  # a stream yields at most twice
+        ]
+        if not victims:
+            return
+        victims.sort(
+            key=lambda e: (
+                e[1].deadline if e[1].deadline is not None else float("inf")
+            ),
+            reverse=True,
+        )
+        n = 0
+        for slot, st in victims:
+            if n >= want or len(self.free) >= want:
+                break
+            self.active.pop(slot)
+            self.sampled_slots.discard(slot)
+            self.free.append(slot)
+            if self.admission is not None:
+                self.admission.release(st)
+            self._requeue_preempted(st)
+            self.preemptions += 1
+            metrics.PREEMPTIONS.labels(self.engine.bundle.name).inc()
+            n += 1
+        if n:
+            # The vacated slots must go to the interactive waiters, not
+            # straight back to the batch class we just preempted.
+            self.queue.prefer_interactive()
+
+    def _requeue_preempted(self, st: _Stream) -> None:
+        """Checkpoint + re-queue one preempted stream.
+
+        Two token-identical resume strategies:
+        - **Recast** (decoder-only causal LMs, greedy): the remaining
+          generation from prompt+delivered IS the continuation, so the
+          stream re-enters admission as a fresh prompt — riding the
+          slot-recast machinery prefix-hit admissions already use, and
+          often hitting the prefix cache the original prompt donated
+          to.  O(delivered) re-prefill, no wasted decode.
+        - **Replay** (everything else): re-run the whole deterministic
+          generation and suppress the first ``skip`` tokens.  Costs
+          recompute, works for any family (encoder-decoders cannot
+          re-enter decoder history through admission)."""
+        remaining = st.budget - st.produced
+        if remaining <= 0 or st.cancelled.is_set():
+            self._finish(st)
+            return
+        st.started = True
+        st.preempted += 1
+        greedy = float(st.feats.get("temperature", 0.0)) == 0.0
+        ids = np.asarray(st.feats["input_ids"], np.int32)[
+            : int(st.feats["length"])
+        ]
+        new_len = int(ids.size) + len(st.tokens)
+        if (
+            greedy
+            and getattr(self.engine.bundle, "supports_prefix", False)
+            and st.skip == 0
+            and new_len <= self.max_prompt
+        ):
+            st.feats = dict(
+                st.feats,
+                input_ids=np.concatenate(
+                    [ids, np.asarray(st.tokens, np.int32)]
+                ),
+                length=np.int32(new_len),
+            )
+            st.budget = remaining
+            st.tokens = []  # folded into the prompt above
+        else:
+            st.skip = len(st.tokens)
+        st.produced = 0
+        self.queue.put(st, force=True)
+
+    def _emit_tokens(self, st: _Stream, chunk) -> None:
+        """Deliver one chunk to a stream: honor the replay-resume
+        suppression cursor and record delivered tokens for any later
+        preemption checkpoint."""
+        arr = np.asarray(chunk)
+        if st.skip:
+            k = min(st.skip, int(arr.size))
+            st.skip -= k
+            arr = arr[k:]
+        if arr.size:
+            st.tokens.extend(int(t) for t in arr.tolist())
+            st.emit(arr)
+            metrics.TOKENS.labels(self.engine.bundle.name).inc(int(arr.size))
 
     # -- admission -----------------------------------------------------
 
@@ -666,8 +904,7 @@ class ContinuousDecodeLoop:
         for st, state1, toks, sampled, row, ids, mask in started:
             toks_np, done_np = fetched[id(toks)]
             st.produced = eng.chunk_tokens
-            st.emit(toks_np[row])
-            metrics.TOKENS.labels(eng.bundle.name).inc(int(toks_np[row].size))
+            self._emit_tokens(st, toks_np[row])
             if bool(done_np[row]) or st.produced >= st.budget:
                 self._finish(st)
                 continue
@@ -930,15 +1167,10 @@ class ContinuousDecodeLoop:
                 # A verify round can overshoot the budget mid-chunk;
                 # trim so the stream never emits past it.
                 chunk = chunk[: st.budget - st.produced]
-                if chunk.size:
-                    st.emit(chunk)
-                    metrics.TOKENS.labels(eng.bundle.name).inc(int(chunk.size))
                 st.produced += int(chunk.size)
+                self._emit_tokens(st, chunk)
             else:
-                st.emit(toks_np[slot])
-                metrics.TOKENS.labels(eng.bundle.name).inc(
-                    int(toks_np[slot].size)
-                )
+                self._emit_tokens(st, toks_np[slot])
                 st.produced += eng.chunk_tokens
             if bool(done_np[slot]) or st.produced >= st.budget:
                 st.emit(_END)

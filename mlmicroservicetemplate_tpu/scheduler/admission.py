@@ -1,0 +1,128 @@
+"""Admission control: priority classes, deadlines, KV-footprint budget,
+drain gate.
+
+Sits between the HTTP layer and the wait queues (``policy.py``).  Three
+decisions happen HERE, at submit time, instead of being discovered
+deep in the serving path:
+
+- **Classification**: ``X-Priority`` (interactive | batch, config
+  default) and ``X-Deadline-Ms`` (config default; 0 = none) become the
+  queue's scheduling fields.
+- **KV budget**: each request's cache footprint is estimated up front
+  (``InferenceEngine.kv_bytes_estimate`` — prompt bucket + decode
+  budget + model dims + the active QUANT_KV dtype).  Work that could
+  NEVER fit the budget sheds immediately (503 ``kv_budget``); work that
+  would overcommit the CURRENTLY committed HBM is down-classed to
+  ``batch`` and waits for capacity instead of failing at slot-insert.
+  The budget then gates DEQUEUE: an item leaves the wait queue only
+  when its reservation fits.
+- **Drain**: once ``draining`` flips (SIGTERM), every new admission
+  sheds with 503 ``drain`` while admitted work runs to completion.
+
+The controller is shared by the batcher's request queue and the
+continuous decode loop's stream queue, so the committed-bytes ledger
+covers both.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import metrics
+from .policy import BATCH, CLASSES, INTERACTIVE, QueueFullError
+
+
+class AdmissionController:
+    """Shared admission policy + committed-KV ledger for one model."""
+
+    def __init__(self, cfg, engine=None):
+        self.engine = engine
+        self.model = getattr(
+            getattr(engine, "bundle", None), "name", "unknown"
+        )
+        default = str(
+            getattr(cfg, "priority_default", INTERACTIVE) or INTERACTIVE
+        ).lower()
+        self.default_class = default if default in CLASSES else INTERACTIVE
+        self.default_deadline_ms = float(
+            getattr(cfg, "deadline_ms", 0.0) or 0.0
+        )
+        self.kv_budget_bytes = int(
+            float(getattr(cfg, "kv_budget_mb", 0.0) or 0.0) * 1e6
+        )
+        self._committed = 0
+        self._lock = threading.Lock()
+        self.draining = False
+
+    # -- classification ------------------------------------------------
+
+    def classify(self, feats: dict) -> tuple[str, float | None]:
+        """(klass, absolute monotonic deadline | None) from the request's
+        scheduling fields (set by the API layer off the X-Priority /
+        X-Deadline-Ms headers), with config defaults."""
+        klass = str(feats.get("priority") or self.default_class).lower()
+        if klass not in CLASSES:  # header syntax is 400-checked upstream
+            klass = self.default_class
+        dl_ms = feats.get("deadline_ms")
+        dl_ms = float(dl_ms) if dl_ms is not None else self.default_deadline_ms
+        deadline = time.monotonic() + dl_ms / 1e3 if dl_ms > 0 else None
+        return klass, deadline
+
+    # -- KV budget -----------------------------------------------------
+
+    def kv_bytes(self, feats: dict) -> int:
+        est = getattr(self.engine, "kv_bytes_estimate", None)
+        return int(est(feats)) if est is not None else 0
+
+    def admit(self, feats: dict, klass: str) -> tuple[str, int]:
+        """Drain + KV-budget gate.  Returns (possibly down-classed
+        klass, kv bytes); raises ``QueueFullError`` with reason
+        ``drain`` or ``kv_budget``."""
+        if self.draining:
+            raise QueueFullError(
+                "server is draining", reason="drain", retry_after_s=5.0
+            )
+        kv = self.kv_bytes(feats)
+        if self.kv_budget_bytes:
+            if kv > self.kv_budget_bytes:
+                raise QueueFullError(
+                    f"request KV footprint {kv}B exceeds the "
+                    f"{self.kv_budget_bytes}B budget",
+                    reason="kv_budget",
+                )
+            with self._lock:
+                over = self._committed + kv > self.kv_budget_bytes
+            if over and klass == INTERACTIVE:
+                # Transient overcommit: wait out the pressure in the
+                # lower class instead of failing at slot-insert.
+                klass = BATCH
+        return klass, kv
+
+    def fits(self, item) -> bool:
+        """Dequeue gate: may this waiter's KV reservation commit now?"""
+        if not self.kv_budget_bytes:
+            return True
+        with self._lock:
+            return self._committed + getattr(item, "kv", 0) \
+                <= self.kv_budget_bytes
+
+    def reserve(self, item) -> None:
+        kv = getattr(item, "kv", 0)
+        if kv and not item.kv_held:
+            with self._lock:
+                self._committed += kv
+                metrics.KV_COMMITTED.labels(self.model).set(self._committed)
+            item.kv_held = True
+
+    def release(self, item) -> None:
+        if getattr(item, "kv_held", False):
+            with self._lock:
+                self._committed -= item.kv
+                metrics.KV_COMMITTED.labels(self.model).set(self._committed)
+            item.kv_held = False
+
+    @property
+    def committed_bytes(self) -> int:
+        with self._lock:
+            return self._committed

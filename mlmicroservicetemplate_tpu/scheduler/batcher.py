@@ -1,19 +1,35 @@
-"""Asyncio dynamic batcher: accumulate → dispatch → route futures.
+"""Asyncio dynamic batcher: admit → queue (deadline-aware) → dispatch →
+route futures.
 
-Policy (mirrors the reference's queue, SURVEY.md §2 "Dynamic-batching
-queue"): a batch closes when it reaches ``max_batch`` items or when
-``batch_timeout_ms`` has elapsed since its first item arrived —
-whichever comes first.  A burst that is already queued forms a full
-batch with zero added wait (the fast path drains without touching a
-timer).
+Batch-formation policy (mirrors the reference's queue, SURVEY.md §2
+"Dynamic-batching queue"): a batch closes when it reaches ``max_batch``
+items or when ``batch_timeout_ms`` has elapsed since its first item
+arrived — whichever comes first.  A burst that is already queued forms
+a full batch with zero added wait.
 
-Device dispatch happens on a single worker thread
-(``run_in_executor``): JAX's blocking ``device_get`` must not stall the
-event loop, which on this 1-vCPU host also runs HTTP parsing and
-pre/post-processing (SURVEY.md §7.4.3).
+On top of that FIFO core sits the SLA scheduler (``policy.py`` +
+``admission.py``): requests carry a priority class and an optional
+deadline, the wait queue is earliest-deadline-first within class and
+class-weighted across classes, stale waiters shed as fast 504s, and a
+KV-footprint budget keeps the admitted working set inside HBM.  With
+no headers and the default config the observable behavior degrades to
+exactly the seed's FIFO + 503 contract.
 
-Backpressure: beyond ``max_queue`` waiting items, ``submit`` raises
-``QueueFullError`` which the API layer maps to 503 load-shed.
+Dequeue is gated on dispatch capacity (``pipeline_depth`` batches in
+flight): the wait queue is the REAL queue, not a relay into an
+invisible unbounded executor backlog — which is what makes deadlines,
+priorities and the KV budget actually bind.
+
+Device dispatch happens on worker threads (``run_in_executor``): JAX's
+blocking ``device_get`` must not stall the event loop, which on this
+1-vCPU host also runs HTTP parsing and pre/post-processing (SURVEY.md
+§7.4.3).
+
+Backpressure: past ``max_queue`` waiting items, ``submit`` sheds —
+either the newcomer or, when the newcomer outranks it, the
+lowest-class latest-deadline waiter — with ``QueueFullError`` which
+the API layer maps to 503 + Retry-After.  ``begin_drain()`` (SIGTERM)
+stops admission while everything already admitted runs to completion.
 """
 
 from __future__ import annotations
@@ -27,12 +43,40 @@ from typing import Any, AsyncIterator
 import numpy as np
 
 from ..utils import metrics
+from .admission import AdmissionController
+from .policy import (  # noqa: F401  (QueueFullError re-exported here)
+    BATCH,
+    INTERACTIVE,
+    DeadlineExceededError,
+    DeadlineQueue,
+    QueueFullError,
+)
 
 _END = object()
 
 
-class QueueFullError(Exception):
-    """Queue at capacity; shed load (HTTP 503)."""
+class _QueuedCall:
+    """One queued non-stream request: its future + scheduling fields."""
+
+    __slots__ = (
+        "feats", "future", "t_in", "klass", "deadline", "started",
+        "kv", "kv_held", "_removed",
+    )
+
+    def __init__(self, feats, future, klass, deadline, kv):
+        self.feats = feats
+        self.future = future
+        self.t_in = time.monotonic()
+        self.klass = klass
+        self.deadline = deadline
+        self.started = False
+        self.kv = kv
+        self.kv_held = False
+        self._removed = False
+
+    def fail(self, exc: BaseException) -> None:
+        if not self.future.done():
+            self.future.set_exception(exc)
 
 
 class Batcher:
@@ -42,7 +86,11 @@ class Batcher:
         self.max_batch = int(cfg.max_batch)
         self.timeout_s = float(cfg.batch_timeout_ms) / 1000.0
         self.max_queue = int(cfg.max_queue)
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self.admission = AdmissionController(cfg, engine)
+        self._queue = DeadlineQueue(
+            self.max_queue, weight=int(getattr(cfg, "class_weight", 4))
+        )
+        self._wake = asyncio.Event()
         # Dispatch threads = pipeline depth: batches overlap in flight
         # so the host<->device round-trip of batch N hides behind the
         # compute of batch N+1 (the engine's semaphore is the real cap).
@@ -50,6 +98,14 @@ class Batcher:
         self._executor = ThreadPoolExecutor(
             max_workers=depth, thread_name_prefix="dispatch"
         )
+        # Dequeue gate: at most ``depth`` batches leave the wait queue
+        # concurrently, so backpressure (and with it deadline expiry,
+        # priority ordering and the KV budget) applies in the QUEUE
+        # rather than in an invisible executor backlog.
+        self._dispatch_sem = asyncio.Semaphore(depth)
+        # EWMAs behind the Retry-After guidance on 503 sheds.
+        self._batch_ewma_s = 0.05
+        self._stream_ewma_s = 1.0
         # Streams hold a worker for their whole generation, so they get
         # their own pool — a long-running stream must never starve the
         # batch dispatch path.  Beyond max_streams concurrent streams we
@@ -76,6 +132,8 @@ class Batcher:
             # MAX_STREAMS caps TOTAL concurrent generations: each side
             # counts the other's active streams in its admission check.
             self._cdl.external_active = lambda: self._active_streams
+            # One admission controller (and KV ledger) for both queues.
+            self._cdl.admission = self.admission
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -85,7 +143,7 @@ class Batcher:
     async def stop(self) -> None:
         self._closed = True
         if self._task is not None:
-            self._queue.put_nowait(_END)
+            self._wake.set()
             await self._task
             self._task = None
         if self._inflight:
@@ -103,16 +161,93 @@ class Batcher:
             self._cdl.warm()
 
     # ------------------------------------------------------------------
+    # drain lifecycle (SIGTERM)
+
+    def begin_drain(self) -> None:
+        """Stop admitting (new work sheds 503 ``drain``); everything
+        already queued or in flight runs to completion."""
+        self.admission.draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self.admission.draining
+
+    def pending_work(self) -> int:
+        """Admitted-but-unfinished items across both serving paths."""
+        n = self._queue.qsize() + len(self._inflight) + self._active_streams
+        if self._cdl is not None:
+            n += self._cdl._admitted + len(self._cdl._inflight_chunks)
+        return n
+
+    async def drained(self, timeout_s: float = 30.0) -> bool:
+        """Await quiescence after ``begin_drain``; True when everything
+        finished inside the grace window."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while self.pending_work() > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return self.pending_work() == 0
+
+    # ------------------------------------------------------------------
+    # shed helpers
+
+    def _shed(self, reason: str) -> None:
+        metrics.SHED.labels(self.model, reason).inc()
+
+    def retry_after_s(self, streams: bool = False) -> float:
+        """Client guidance on 503: expected seconds until capacity,
+        from current depth × the observed service-time EWMA."""
+        if streams:
+            waiting = self._active_streams
+            if self._cdl is not None:
+                waiting += self._cdl._admitted
+            est = (waiting + 1) * self._stream_ewma_s / max(1, self.max_streams)
+        else:
+            est = (
+                self._queue.qsize() / max(1, self.max_batch) + 1.0
+            ) * self._batch_ewma_s
+        return min(60.0, max(1.0, est))
+
+    def _depth_gauges(self) -> None:
+        metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
+        for klass in (INTERACTIVE, BATCH):
+            metrics.CLASS_QUEUE_DEPTH.labels(self.model, "batch", klass).set(
+                self._queue.waiting(klass)
+            )
+
+    # ------------------------------------------------------------------
     async def submit(self, feats: dict) -> np.ndarray:
-        """Enqueue one preprocessed item; resolves to its result row."""
+        """Enqueue one preprocessed item; resolves to its result row.
+
+        Sheds with ``QueueFullError`` (503: queue_full | kv_budget |
+        drain) or, when the deadline passes before dispatch,
+        ``DeadlineExceededError`` (504)."""
         if self._closed:
             raise RuntimeError("batcher is stopped")
-        if self._queue.qsize() >= self.max_queue:
-            raise QueueFullError(f"queue depth {self._queue.qsize()} >= {self.max_queue}")
+        klass, deadline = self.admission.classify(feats)
+        try:
+            klass, kv = self.admission.admit(feats, klass)
+        except QueueFullError as e:
+            if e.retry_after_s is None:
+                e.retry_after_s = self.retry_after_s()
+            self._shed(e.reason)
+            raise
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._queue.put_nowait((feats, fut, time.monotonic()))
-        metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
+        item = _QueuedCall(feats, fut, klass, deadline, kv)
+        try:
+            victim = self._queue.put(item)
+        except QueueFullError as e:
+            e.retry_after_s = self.retry_after_s()
+            self._shed("queue_full")
+            raise
+        if victim is not None:
+            self._shed("queue_full")
+            victim.fail(QueueFullError(
+                "shed for higher-priority work",
+                retry_after_s=self.retry_after_s(),
+            ))
+        self._wake.set()
+        self._depth_gauges()
         return await fut
 
     def submit_stream(self, feats: dict) -> AsyncIterator[np.ndarray]:
@@ -163,15 +298,33 @@ class Batcher:
             and not sampled_opt_out
             and int(feats.get("length", 0)) <= self._cdl.max_prompt
         ):
+            # Deadline-queued admission (and preemption) live in the
+            # continuous loop; it raises QueueFullError / emits
+            # DeadlineExceededError itself.
             return self._cdl.submit_stream(feats)
+        # Legacy per-stream path (oversized prompts, spec routing, or
+        # CONTINUOUS_BATCHING=0): the worker pool admits instantly or
+        # sheds — no wait queue, but the drain/KV admission gates and
+        # shed accounting still apply.
+        klass, _deadline = self.admission.classify(feats)
+        try:
+            self.admission.admit(feats, klass)
+        except QueueFullError as e:
+            if e.retry_after_s is None:
+                e.retry_after_s = self.retry_after_s(streams=True)
+            self._shed(e.reason)
+            raise
         # Oversized prompts (longer than the largest seq bucket) cannot
         # join the shared slot batch; they keep the per-stream path —
         # but MAX_STREAMS caps TOTAL concurrent generations, so count
         # the loop's admissions too.
         cdl_active = self._cdl._admitted if self._cdl is not None else 0
         if self._active_streams + cdl_active >= self.max_streams:
+            self._shed("queue_full")
             raise QueueFullError(
-                f"{self._active_streams} streams active >= max_streams={self.max_streams}"
+                f"{self._active_streams} streams active >= "
+                f"max_streams={self.max_streams}",
+                retry_after_s=self.retry_after_s(streams=True),
             )
         loop = asyncio.get_running_loop()
         chunks: asyncio.Queue = asyncio.Queue()
@@ -202,10 +355,13 @@ class Batcher:
                 loop.call_soon_threadsafe(chunks.put_nowait, e)
 
         self._active_streams += 1
+        t_started = time.monotonic()
         pump_fut = loop.run_in_executor(self._stream_executor, pump)
 
         def _release(_fut):
             self._active_streams -= 1
+            dt = time.monotonic() - t_started
+            self._stream_ewma_s = 0.8 * self._stream_ewma_s + 0.2 * dt
 
         pump_fut.add_done_callback(_release)
 
@@ -226,49 +382,118 @@ class Batcher:
         return gen()
 
     # ------------------------------------------------------------------
+    def _expire(self) -> None:
+        """Fail every waiter whose deadline passed — a fast 504 NOW
+        beats serving stale work or a client-side timeout later."""
+        for item in self._queue.expire():
+            self._shed("deadline")
+            item.fail(DeadlineExceededError(
+                "deadline passed while queued; request shed before dispatch"
+            ))
+
+    def _pop_ready(self):
+        """Expire stale waiters, then pop the next schedulable item
+        (KV-budget-gated unless the batcher is shutting down) and
+        reserve its KV commitment."""
+        self._expire()
+        fits = None if self._closed else self.admission.fits
+        item = self._queue.pop_nowait(fits=fits)
+        if item is not None:
+            self.admission.reserve(item)
+        return item
+
+    async def _wait_wake(self, timeout: float | None) -> None:
+        try:
+            if timeout is None:
+                await self._wake.wait()
+            else:
+                await asyncio.wait_for(self._wake.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        self._wake.clear()
+
+    async def _next_item(self):
+        """Block until an item is schedulable (or the batcher is closed
+        AND fully drained → None).  Wakes on submits, on the next
+        waiter's deadline (for prompt 504s), and on a short poll while
+        items wait only on KV capacity."""
+        while True:
+            item = self._pop_ready()
+            if item is not None:
+                return item
+            if self._closed and self._queue.qsize() == 0:
+                return None
+            timeout = None
+            nd = self._queue.next_deadline()
+            if nd is not None:
+                timeout = max(0.01, nd - time.monotonic())
+            if self._queue.qsize() > 0 or self._closed:
+                # Items waiting on KV release (no event fires for it)
+                # or shutdown in progress: poll.
+                timeout = 0.05 if timeout is None else min(timeout, 0.05)
+            await self._wait_wake(timeout)
+
+    async def _acquire_dispatch(self) -> None:
+        """Take a dispatch slot, sweeping deadline expiry while blocked
+        (all ``pipeline_depth`` slots busy) so queued work still 504s
+        on time instead of rotting behind a saturated device."""
+        while True:
+            try:
+                await asyncio.wait_for(self._dispatch_sem.acquire(), 0.05)
+                return
+            except asyncio.TimeoutError:
+                self._expire()
+
     async def _run(self) -> None:
         while True:
-            first = await self._queue.get()
-            if first is _END:
+            await self._acquire_dispatch()
+            first = await self._next_item()
+            if first is None:
+                self._dispatch_sem.release()
                 return
-            # Keep the depth gauge honest on drain: pulling the last
-            # queued item must drop it to 0 now, not at the next submit.
-            metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
+            self._depth_gauges()
             batch = [first]
             deadline = time.monotonic() + self.timeout_s
             while len(batch) < self.max_batch:
-                # Fast path: drain whatever is already queued.
-                try:
-                    item = self._queue.get_nowait()
-                except asyncio.QueueEmpty:
+                # Fast path: drain whatever is already schedulable.
+                item = self._pop_ready()
+                if item is None:
+                    if self._closed:
+                        break
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
-                    try:
-                        item = await asyncio.wait_for(self._queue.get(), remaining)
-                    except asyncio.TimeoutError:
-                        break
-                if item is _END:
-                    self._spawn_dispatch(batch)
-                    return
+                    await self._wait_wake(remaining)
+                    item = self._pop_ready()
+                    if item is None:
+                        if time.monotonic() >= deadline:
+                            break
+                        continue
                 batch.append(item)
-            metrics.QUEUE_DEPTH.labels(self.model).set(self._queue.qsize())
+            self._depth_gauges()
             # Fire-and-track: the batcher immediately goes back to
             # collecting while this batch's device round-trip is in
-            # flight (bounded by the engine's pipeline semaphore).
+            # flight (bounded by the dispatch semaphore + the engine's
+            # pipeline semaphore).
             self._spawn_dispatch(batch)
 
     def _spawn_dispatch(self, batch: list) -> None:
         task = asyncio.get_running_loop().create_task(self._dispatch(batch))
         self._inflight.add(task)
-        task.add_done_callback(self._inflight.discard)
+
+        def _done(t):
+            self._inflight.discard(t)
+            self._dispatch_sem.release()
+            self._wake.set()
+
+        task.add_done_callback(_done)
 
     async def _dispatch(self, batch: list) -> None:
         loop = asyncio.get_running_loop()
         now = time.monotonic()
-        feats = [b[0] for b in batch]
-        for _, _, t_in in batch:
-            metrics.QUEUE_WAIT.labels(self.model).observe(now - t_in)
+        feats = [item.feats for item in batch]
+        for item in batch:
+            metrics.QUEUE_WAIT.labels(self.model).observe(now - item.t_in)
         metrics.BATCH_SIZE.labels(self.model).observe(len(batch))
         t0 = time.monotonic()
         try:
@@ -276,14 +501,18 @@ class Batcher:
                 self._executor, self.engine.run_batch, feats
             )
         except Exception as e:
-            for _, fut, _ in batch:
-                if not fut.done():
-                    fut.set_exception(e)
+            for item in batch:
+                item.fail(e)
             return
-        metrics.DEVICE_TIME.labels(self.model).observe(time.monotonic() - t0)
-        for (_, fut, _), row in zip(batch, rows):
-            if not fut.done():
-                fut.set_result(row)
+        finally:
+            for item in batch:
+                self.admission.release(item)
+        dt = time.monotonic() - t0
+        self._batch_ewma_s = 0.8 * self._batch_ewma_s + 0.2 * dt
+        metrics.DEVICE_TIME.labels(self.model).observe(dt)
+        for item, row in zip(batch, rows):
+            if not item.future.done():
+                item.future.set_result(row)
 
 
 def batch_results(rows: list[np.ndarray]) -> Any:

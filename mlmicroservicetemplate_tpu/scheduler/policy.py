@@ -1,0 +1,292 @@
+"""Deadline-aware, class-weighted wait queue (the scheduler's policy
+core).
+
+The seed's front door was binary: a raw FIFO ``asyncio.Queue`` in the
+batcher and an instant 503 past ``max_streams`` in the stream loop.
+This module replaces both with one policy structure, following the
+memory-aware / SLA-constrained batching literature (PAPERS.md): what
+decides goodput under overload is WHICH request waits, for HOW long,
+and which one is shed — not the kernels.
+
+Policy, in one place:
+
+- Two priority classes (``interactive`` > ``batch``), selected per
+  request via the ``X-Priority`` header with a config default.
+- Earliest-deadline-first ordering WITHIN a class; FIFO tie-break for
+  deadline-less requests (so the default config degrades to exactly
+  the seed's FIFO behavior).
+- Class-weighted dequeue ACROSS classes: ``weight`` interactive pops
+  per batch pop while both classes wait, so batch work cannot starve
+  but never delays interactive work by more than 1/weight.
+- Overload shed on ``put``: the victim is the lowest-class,
+  latest-deadline waiter — and only if the newcomer outranks it;
+  otherwise the newcomer itself is shed (503).
+- Expiry: a request still waiting past its deadline is removed and
+  failed FAST (504 before dispatch) instead of being served stale or
+  timing out client-side after burning device time.
+
+Thread-safe: the batcher puts/pops on the asyncio event loop while the
+continuous decode loop pops from its owner thread.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+INTERACTIVE = "interactive"
+BATCH = "batch"
+#: Rank order: earlier = higher priority.
+CLASSES = (INTERACTIVE, BATCH)
+
+
+class QueueFullError(Exception):
+    """Queue at capacity; shed load (HTTP 503).
+
+    ``reason`` labels the shed counter (queue_full | kv_budget | drain);
+    ``retry_after_s`` rides to the HTTP Retry-After header.
+    """
+
+    def __init__(self, msg: str = "", reason: str = "queue_full",
+                 retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(Exception):
+    """The request's deadline passed while it waited (HTTP 504)."""
+
+
+def _dl(item) -> float:
+    """Sort key: absolute monotonic deadline, None = no deadline = last."""
+    return item.deadline if item.deadline is not None else float("inf")
+
+
+class DeadlineQueue:
+    """Bounded two-class EDF wait queue (see module docstring).
+
+    Queued items must expose attributes ``klass`` (interactive|batch),
+    ``deadline`` (absolute ``time.monotonic()`` seconds or None),
+    ``started`` (True once response bytes went out: exempt from expiry
+    and eviction — a preempted stream re-queued for resumption cannot
+    be converted to an HTTP error anymore).  The queue stamps a private
+    ``_removed`` flag for lazy heap deletion.
+    """
+
+    def __init__(self, maxsize: int, weight: int = 4):
+        self.maxsize = max(1, int(maxsize))
+        self.weight = max(1, int(weight))
+        self._heaps: dict[str, list] = {k: [] for k in CLASSES}
+        self._count: dict[str, int] = {k: 0 for k in CLASSES}
+        self._cond = threading.Condition()
+        self._seq = itertools.count()
+        self._streak = 0  # consecutive interactive pops while batch waits
+
+    # -- introspection -------------------------------------------------
+
+    def qsize(self) -> int:
+        with self._cond:
+            return sum(self._count.values())
+
+    def waiting(self, klass: str) -> int:
+        with self._cond:
+            return self._count[klass]
+
+    def waiting_started(self) -> int:
+        """Checkpointed (preempted) streams still waiting to resume."""
+        with self._cond:
+            return sum(
+                1
+                for heap in self._heaps.values()
+                for _, it in heap
+                if not it._removed and it.started
+            )
+
+    def next_deadline(self) -> float | None:
+        """Earliest expirable deadline among waiting items (idle-wake
+        timer for the batcher's expiry sweep)."""
+        with self._cond:
+            best = None
+            for heap in self._heaps.values():
+                for _, it in heap:
+                    if it._removed or it.started or it.deadline is None:
+                        continue
+                    if best is None or it.deadline < best:
+                        best = it.deadline
+            return best
+
+    # -- enqueue -------------------------------------------------------
+
+    def put(self, item, force: bool = False):
+        """Enqueue; returns an evicted lower-ranked waiter (the caller
+        fails it with a 503) or None.  Raises ``QueueFullError`` when
+        full and the newcomer outranks nobody.  ``force`` bypasses the
+        bound (re-queueing a preempted, already-started stream)."""
+        with self._cond:
+            victim = None
+            if not force and sum(self._count.values()) >= self.maxsize:
+                victim = self._pick_victim_locked(item)
+                if victim is None:
+                    raise QueueFullError(
+                        f"queue depth {sum(self._count.values())} >= "
+                        f"{self.maxsize}"
+                    )
+                victim._removed = True
+                self._count[victim.klass] -= 1
+            item._removed = False
+            key = (_dl(item), next(self._seq))
+            heapq.heappush(self._heaps[item.klass], (key, item))
+            self._count[item.klass] += 1
+            self._cond.notify()
+            return victim
+
+    def evict_for(self, incoming):
+        """Shed-for-admission without enqueueing: returns (and removes)
+        the victim ``incoming`` outranks, or None.  Used by callers that
+        bound admission on something wider than this queue's size (the
+        stream loop counts active slots too)."""
+        with self._cond:
+            victim = self._pick_victim_locked(incoming)
+            if victim is not None:
+                victim._removed = True
+                self._count[victim.klass] -= 1
+            return victim
+
+    def _pick_victim_locked(self, incoming):
+        """Lowest-class latest-deadline waiter that ``incoming``
+        outranks: strictly lower class, or same class with a strictly
+        later deadline.  Started items are never evicted."""
+        for klass in reversed(CLASSES):  # lowest class first
+            live = [
+                it for _, it in self._heaps[klass]
+                if not it._removed and not it.started
+            ]
+            if not live:
+                continue
+            victim = max(live, key=_dl)
+            inc_rank = CLASSES.index(incoming.klass)
+            v_rank = CLASSES.index(klass)
+            if inc_rank < v_rank:
+                return victim
+            if inc_rank == v_rank and _dl(incoming) < _dl(victim):
+                return victim
+            return None
+        return None
+
+    # -- dequeue -------------------------------------------------------
+
+    def pop_nowait(self, fits=None):
+        """EDF-within-class, class-weighted-across-classes pop; returns
+        None when empty (or when no waiter passes ``fits`` — the
+        KV-budget admission gate)."""
+        with self._cond:
+            return self._pop_locked(fits)
+
+    def pop(self, timeout: float | None = None, fits=None):
+        """Blocking pop for the decode-loop thread."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                item = self._pop_locked(fits)
+                if item is not None:
+                    return item
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                if not self._cond.wait(timeout=remaining):
+                    return self._pop_locked(fits)
+
+    def prefer_interactive(self) -> None:
+        """Reset the weighted-dequeue streak so the next pop serves the
+        interactive class (used right after a preemption: the slot that
+        was just vacated must not go back to a batch waiter)."""
+        with self._cond:
+            self._streak = 0
+
+    def _pop_locked(self, fits):
+        for klass in self._class_order_locked():
+            item = self._pop_class_locked(klass, fits)
+            if item is not None:
+                if klass == INTERACTIVE and self._count[BATCH] > 0:
+                    self._streak += 1
+                else:
+                    self._streak = 0
+                return item
+        return None
+
+    def _class_order_locked(self):
+        if self._count[INTERACTIVE] and self._count[BATCH]:
+            if self._streak >= self.weight:
+                return (BATCH, INTERACTIVE)
+            return (INTERACTIVE, BATCH)
+        return (INTERACTIVE, BATCH) if self._count[INTERACTIVE] else (
+            BATCH, INTERACTIVE
+        )
+
+    def _pop_class_locked(self, klass: str, fits):
+        heap = self._heaps[klass]
+        stash = []
+        found = None
+        while heap:
+            key, it = heapq.heappop(heap)
+            if it._removed:
+                continue
+            if fits is not None and not fits(it):
+                # Head-of-line doesn't fit the admission budget: look
+                # past it (a smaller request may) — expiry bounds how
+                # long the skipped head can languish.
+                stash.append((key, it))
+                continue
+            it._removed = True
+            self._count[klass] -= 1
+            found = it
+            break
+        for entry in stash:
+            heapq.heappush(heap, entry)
+        return found
+
+    # -- expiry / shutdown --------------------------------------------
+
+    def expire(self, now: float | None = None) -> list:
+        """Remove and return every waiter whose deadline passed (the
+        caller fails them with ``DeadlineExceededError`` → 504).
+        Started items never expire."""
+        now = time.monotonic() if now is None else now
+        out = []
+        with self._cond:
+            for klass in CLASSES:
+                heap = self._heaps[klass]
+                repush = []
+                while heap and heap[0][0][0] <= now:
+                    key, it = heapq.heappop(heap)
+                    if it._removed:
+                        continue
+                    if it.started:
+                        repush.append((key, it))
+                        continue
+                    it._removed = True
+                    self._count[klass] -= 1
+                    out.append(it)
+                for entry in repush:
+                    heapq.heappush(heap, entry)
+        return out
+
+    def drain_all(self) -> list:
+        """Remove and return everything (shutdown path)."""
+        with self._cond:
+            out = [
+                it
+                for heap in self._heaps.values()
+                for _, it in heap
+                if not it._removed
+            ]
+            for it in out:
+                it._removed = True
+            self._heaps = {k: [] for k in CLASSES}
+            self._count = {k: 0 for k in CLASSES}
+            return out

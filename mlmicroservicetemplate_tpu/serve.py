@@ -74,8 +74,41 @@ def build_service(overrides: dict | None = None):
     return cfg, bundle, engine, batcher, app
 
 
-def main(argv: list[str] | None = None) -> None:
+async def _serve_until_signalled(app, cfg) -> None:
+    """``web.run_app`` replacement with the SLA-aware lifecycle: on
+    SIGTERM/SIGINT the server flips into drain mode (readyz → 503 so
+    load balancers stop routing; new admissions shed 503 ``drain`` with
+    Retry-After) and only exits once in-flight streams and queued
+    batches finished — or the DRAIN_GRACE_S window closed."""
+    import asyncio
+    import signal
+
     from aiohttp import web
+
+    from .api.app import drain_app
+
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.host, cfg.port)
+    await site.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-unix platform or nested loop: no graceful drain
+    await stop.wait()
+    log = logging.getLogger("serve")
+    log.info(
+        "signal received: draining (grace %.0fs)", cfg.drain_grace_s
+    )
+    await drain_app(app, cfg.drain_grace_s)
+    await runner.cleanup()
+
+
+def main(argv: list[str] | None = None) -> None:
+    import asyncio
 
     overrides = parse_args(argv)
     cfg, bundle, _, _, app = build_service(overrides)
@@ -84,7 +117,7 @@ def main(argv: list[str] | None = None) -> None:
         "serving %s on %s:%d (device=%s, max_batch=%d)",
         bundle.name, cfg.host, cfg.port, cfg.device, cfg.max_batch,
     )
-    web.run_app(app, host=cfg.host, port=cfg.port, access_log=None)
+    asyncio.run(_serve_until_signalled(app, cfg))
 
 
 if __name__ == "__main__":

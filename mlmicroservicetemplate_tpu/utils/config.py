@@ -177,6 +177,33 @@ class ServiceConfig(BaseModel):
     prefix_cache: bool = False
     prefix_cache_mb: float = 256.0
 
+    # SLA-aware request scheduling (scheduler/admission.py + policy.py).
+    # Priority class for requests without an X-Priority header.
+    priority_default: str = "interactive"
+    # Default deadline for requests without X-Deadline-Ms, in ms; a
+    # request still WAITING past its deadline sheds as a fast 504
+    # before any device work.  0 = no default deadline.
+    deadline_ms: float = 0.0
+    # Weighted dequeue: interactive pops per batch pop while both
+    # classes wait (batch never starves, interactive never waits more
+    # than 1/weight extra).
+    class_weight: int = 4
+    # KV-footprint admission budget in MB: the cache bytes the admitted
+    # working set may commit (estimated per request from prompt bucket,
+    # decode budget, model dims and the QUANT_KV dtype).  Requests that
+    # can never fit shed 503; transient overcommit down-classes
+    # interactive work to batch.  0 disables the gate.
+    kv_budget_mb: float = 0.0
+    # Streams allowed to WAIT (deadline-queued) beyond max_streams
+    # active; 0 restores the historical instant 503 past max_streams.
+    max_stream_queue: int = 0
+    # Interactive arrivals may preempt batch-class streams (checkpoint
+    # the cursor, free the slot, re-queue for token-identical resume)
+    # when every slot is busy.  Only reachable with MAX_STREAM_QUEUE>0.
+    preempt: bool = True
+    # Seconds the SIGTERM drain waits for in-flight work before exit.
+    drain_grace_s: float = 30.0
+
     # Observability.
     log_level: str = "INFO"
 
@@ -244,6 +271,23 @@ class ServiceConfig(BaseModel):
             raise ValueError("MAX_BATCH must be >= 1")
         return v
 
+    @field_validator("priority_default")
+    @classmethod
+    def _check_priority_default(cls, v: str) -> str:
+        v = v.lower()
+        if v not in ("interactive", "batch"):
+            raise ValueError(
+                f"PRIORITY_DEFAULT must be 'interactive' or 'batch', got {v!r}"
+            )
+        return v
+
+    @field_validator("class_weight")
+    @classmethod
+    def _check_class_weight(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError("CLASS_WEIGHT must be >= 1")
+        return v
+
 
 def _env(name: str, default: str | None = None) -> str | None:
     v = os.environ.get(name)
@@ -259,7 +303,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       MAX_DECODE_LEN, SERVER_URL, WARMUP, LOG_LEVEL, PIPELINE_DEPTH,
       MAX_STREAMS, BATCH_BUCKETS, SEQ_BUCKETS, QUANTIZE,
       REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX,
-      SPEC_DECODE, SPEC_K, SPEC_NGRAM.
+      SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
+      CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
+      DRAIN_GRACE_S.
     """
     e = dict(os.environ)
     if env:
@@ -282,6 +328,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "quant_kv": "QUANT_KV",
         "prompt_prefix": "PROMPT_PREFIX",
         "spec_decode": "SPEC_DECODE",
+        "priority_default": "PRIORITY_DEFAULT",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -301,6 +348,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "spec_ngram": "SPEC_NGRAM",
         "spec_max_streams": "SPEC_MAX_STREAMS",
         "stream_pipeline": "STREAM_PIPELINE",
+        "class_weight": "CLASS_WEIGHT",
+        "max_stream_queue": "MAX_STREAM_QUEUE",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -312,6 +361,17 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("REGISTER_HEARTBEAT_S")
     if v is not None:
         kwargs["register_heartbeat_s"] = float(v)
+    for field, var in (
+        ("deadline_ms", "DEADLINE_MS"),
+        ("kv_budget_mb", "KV_BUDGET_MB"),
+        ("drain_grace_s", "DRAIN_GRACE_S"),
+    ):
+        v = get(var)
+        if v is not None:
+            kwargs[field] = float(v)
+    v = get("PREEMPT")
+    if v is not None:
+        kwargs["preempt"] = v.lower() not in ("0", "false", "no")
     # Comma-separated bucket overrides, e.g. BATCH_BUCKETS=1,8,32 — used
     # to bound warmup compile time when only some shapes will be served.
     for field, var in (("batch_buckets", "BATCH_BUCKETS"), ("seq_buckets", "SEQ_BUCKETS")):

@@ -90,6 +90,33 @@ SPEC_EMITTED = Histogram(
     "draft accepted; the acceptance-rate observability surface)",
     ["model"], buckets=(1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 9.0),
 )
+SHED = Counter(
+    "requests_shed_total",
+    "Load-shed requests by reason "
+    "(queue_full | deadline | kv_budget | drain)",
+    ["model", "reason"],
+)
+TTFT = Histogram(
+    "stream_ttft_seconds",
+    "Streaming time-to-first-token-chunk (submit to first event)",
+    ["model"], buckets=_LATENCY_BUCKETS,
+)
+CLASS_QUEUE_DEPTH = Gauge(
+    "sched_class_queue_depth",
+    "Requests waiting in the deadline queue, by queue and priority class",
+    ["model", "queue", "klass"],
+)
+PREEMPTIONS = Counter(
+    "stream_preemptions_total",
+    "Batch-class streams checkpointed and re-queued to admit "
+    "interactive work",
+    ["model"],
+)
+KV_COMMITTED = Gauge(
+    "kv_committed_bytes",
+    "KV-cache bytes currently committed against the admission budget",
+    ["model"],
+)
 
 
 def render() -> tuple[bytes, str]:

@@ -1,0 +1,563 @@
+"""SLA-scheduler tests (scheduler/policy.py + admission.py and their
+integration into the batcher, the continuous decode loop and the API):
+
+1. DeadlineQueue policy: EDF within class, class-weighted dequeue,
+   lowest-class-latest-deadline eviction on overflow, expiry.
+2. Overload: concurrent submits past capacity shed 503 with
+   Retry-After; queued work whose deadline passes sheds as a fast 504
+   BEFORE dispatch.
+3. KV-budget admission: impossible requests shed (``kv_budget``),
+   transient overcommit down-classes interactive → batch, the budget
+   gates dequeue.
+4. Preemption: an interactive arrival preempts a batch-class stream;
+   the preempted stream resumes token-identically (pinned against the
+   unpreempted reference).
+5. Drain: begin_drain stops admission (503 ``drain`` + Retry-After,
+   readyz → 503) while in-flight streams finish completely.
+"""
+
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.scheduler import Batcher
+from mlmicroservicetemplate_tpu.scheduler.admission import AdmissionController
+from mlmicroservicetemplate_tpu.scheduler.policy import (
+    BATCH,
+    INTERACTIVE,
+    DeadlineExceededError,
+    DeadlineQueue,
+    QueueFullError,
+)
+
+# ---------------------------------------------------------------------------
+# 1. queue policy
+
+
+def _item(klass=INTERACTIVE, deadline=None, kv=0):
+    return SimpleNamespace(
+        klass=klass, deadline=deadline, started=False, kv=kv, kv_held=False
+    )
+
+
+def test_edf_within_class():
+    q = DeadlineQueue(16)
+    now = time.monotonic()
+    a = _item(deadline=now + 3)
+    b = _item(deadline=now + 1)
+    c = _item(deadline=None)  # deadline-less sorts last (FIFO among them)
+    d = _item(deadline=now + 2)
+    for it in (a, b, c, d):
+        q.put(it)
+    assert [q.pop_nowait() for _ in range(4)] == [b, d, a, c]
+    assert q.pop_nowait() is None
+
+
+def test_class_weighted_dequeue():
+    q = DeadlineQueue(32, weight=2)
+    ints = [_item(INTERACTIVE) for _ in range(6)]
+    bats = [_item(BATCH) for _ in range(3)]
+    for it in ints + bats:
+        q.put(it)
+    order = [q.pop_nowait().klass for _ in range(9)]
+    # 2 interactive pops per batch pop while both classes wait: batch
+    # work cannot starve, interactive work leads.
+    assert order == [
+        INTERACTIVE, INTERACTIVE, BATCH,
+        INTERACTIVE, INTERACTIVE, BATCH,
+        INTERACTIVE, INTERACTIVE, BATCH,
+    ]
+
+
+def test_overflow_evicts_lowest_class_latest_deadline():
+    now = time.monotonic()
+    q = DeadlineQueue(2)
+    b_early = _item(BATCH, deadline=now + 1)
+    b_late = _item(BATCH, deadline=now + 5)
+    q.put(b_early)
+    q.put(b_late)
+    # Interactive newcomer outranks batch: the latest-deadline batch
+    # waiter is the victim.
+    victim = q.put(_item(INTERACTIVE))
+    assert victim is b_late
+    # A batch newcomer outranks nobody in an interactive-full queue.
+    q2 = DeadlineQueue(1)
+    q2.put(_item(INTERACTIVE))
+    with pytest.raises(QueueFullError):
+        q2.put(_item(BATCH))
+    # Same class: only an EARLIER deadline outranks.
+    q3 = DeadlineQueue(1)
+    late = _item(INTERACTIVE, deadline=now + 10)
+    q3.put(late)
+    assert q3.put(_item(INTERACTIVE, deadline=now + 1)) is late
+    with pytest.raises(QueueFullError):
+        q3.put(_item(INTERACTIVE, deadline=now + 20))
+
+
+def test_expiry_removes_stale_and_spares_started():
+    now = time.monotonic()
+    q = DeadlineQueue(8)
+    stale = _item(deadline=now - 1)
+    fresh = _item(deadline=now + 60)
+    resumed = _item(BATCH, deadline=now - 1)
+    resumed.started = True  # preempted stream re-queued for resumption
+    for it in (stale, fresh, resumed):
+        q.put(it)
+    assert q.expire() == [stale]
+    assert q.qsize() == 2
+    assert q.pop_nowait() is fresh
+    assert q.pop_nowait() is resumed
+
+
+# ---------------------------------------------------------------------------
+# 2. batcher overload: 503 + Retry-After, deadline 504
+
+
+class FakeEngine:
+    def __init__(self, delay: float = 0.0):
+        self.bundle = SimpleNamespace(name="fake")
+        self.delay = delay
+
+    def run_batch(self, feats):
+        if self.delay:
+            time.sleep(self.delay)
+        return [np.array([f["id"]]) for f in feats]
+
+
+def _cfg(**kw):
+    base = dict(max_batch=8, batch_timeout_ms=2.0, max_queue=1024)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+async def _with_batcher(cfg, engine, body):
+    b = Batcher(engine, cfg)
+    await b.start()
+    try:
+        return await body(b)
+    finally:
+        await b.stop()
+
+
+def test_overload_sheds_503_with_retry_after():
+    """Concurrent submits past max_queue shed with QueueFullError
+    carrying Retry-After guidance; admitted work still completes."""
+    eng = FakeEngine(delay=0.05)
+
+    async def body(b):
+        results = await asyncio.gather(
+            *(b.submit({"id": i}) for i in range(32)), return_exceptions=True
+        )
+        shed = [r for r in results if isinstance(r, QueueFullError)]
+        ok = [r for r in results if isinstance(r, np.ndarray)]
+        assert shed, "expected some requests shed"
+        assert ok, "expected some requests served"
+        assert all(r.reason == "queue_full" for r in shed)
+        assert all(
+            r.retry_after_s is not None and r.retry_after_s >= 1.0
+            for r in shed
+        )
+
+    asyncio.run(_with_batcher(_cfg(max_batch=1, max_queue=2, pipeline_depth=1), eng, body))
+
+
+def test_expired_deadline_sheds_504_before_dispatch():
+    """A queued request whose deadline passes fails FAST with
+    DeadlineExceededError — before the device frees up, not after."""
+    eng = FakeEngine(delay=0.3)
+
+    async def body(b):
+        slow = asyncio.ensure_future(b.submit({"id": 0}))
+        await asyncio.sleep(0.05)  # let it occupy the only dispatch slot
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError):
+            await b.submit({"id": 1, "deadline_ms": 60.0})
+        # Shed while the device was still busy (0.3s): the 504 came
+        # from the expiry sweep, not from waiting out the dispatch.
+        assert time.monotonic() - t0 < 0.25
+        await slow
+
+    asyncio.run(
+        _with_batcher(_cfg(max_batch=1, pipeline_depth=1), eng, body)
+    )
+
+
+def test_priority_orders_dequeue():
+    """With the device busy, a later interactive submit dispatches
+    before earlier batch-class submits."""
+    eng = FakeEngine(delay=0.05)
+    served: list = []
+
+    orig = eng.run_batch
+
+    def record(feats):
+        served.extend(f["id"] for f in feats)
+        return orig(feats)
+
+    eng.run_batch = record
+
+    async def body(b):
+        first = asyncio.ensure_future(b.submit({"id": "warm"}))
+        await asyncio.sleep(0.02)  # occupies the single dispatch slot
+        tasks = [
+            asyncio.ensure_future(
+                b.submit({"id": f"b{i}", "priority": "batch"})
+            )
+            for i in range(3)
+        ]
+        await asyncio.sleep(0)  # everything queued in this loop tick
+        tasks.append(
+            asyncio.ensure_future(
+                b.submit({"id": "i0", "priority": "interactive"})
+            )
+        )
+        await asyncio.gather(first, *tasks)
+        assert served[0] == "warm"
+        assert served[1] == "i0", served
+
+    asyncio.run(
+        _with_batcher(_cfg(max_batch=1, pipeline_depth=1), eng, body)
+    )
+
+
+# ---------------------------------------------------------------------------
+# 3. KV-budget admission
+
+
+def test_kv_budget_rejects_and_downclasses():
+    eng = SimpleNamespace(
+        bundle=SimpleNamespace(name="fake"),
+        kv_bytes_estimate=lambda feats: int(feats.get("kv", 0)),
+    )
+    adm = AdmissionController(_cfg(kv_budget_mb=1.0), eng)
+    # Can never fit: immediate shed, labeled kv_budget.
+    with pytest.raises(QueueFullError) as ei:
+        adm.admit({"kv": 2_000_000}, INTERACTIVE)
+    assert ei.value.reason == "kv_budget"
+    # Transient overcommit: down-class instead of failing later.
+    held = SimpleNamespace(kv=800_000, kv_held=False)
+    adm.reserve(held)
+    klass, kv = adm.admit({"kv": 500_000}, INTERACTIVE)
+    assert klass == BATCH and kv == 500_000
+    # The dequeue gate holds the item while committed + kv > budget...
+    assert not adm.fits(SimpleNamespace(kv=500_000))
+    adm.release(held)
+    # ...and releases it once capacity returns.
+    assert adm.fits(SimpleNamespace(kv=500_000))
+    assert adm.admit({"kv": 500_000}, INTERACTIVE)[0] == INTERACTIVE
+    assert adm.committed_bytes == 0
+
+
+def test_engine_kv_bytes_estimate():
+    from helpers import tiny_t5_bundle
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = tiny_t5_bundle()
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(16, 32), max_decode_len=12, stream_chunk_tokens=4,
+    )
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    short = eng.kv_bytes_estimate(
+        {"input_ids": np.ones(10, np.int32), "length": np.int32(10)}
+    )
+    longer = eng.kv_bytes_estimate(
+        {"input_ids": np.ones(30, np.int32), "length": np.int32(30)}
+    )
+    assert short > 0
+    assert longer > short  # wider prompt bucket -> bigger footprint
+    # t5-tiny at f32: layers=2, kv-heads=2, d_kv=8; width=(16+12),
+    # cross term over the 16-wide encoder bucket.
+    assert short == 2 * 2 * 2 * 28 * 8 * 4 + 2 * 2 * 2 * 16 * 8 * 4
+    # int8 KV halves-ish the per-token bytes (payload + f32 scale).
+    cfg8 = cfg.model_copy(update={"quant_kv": "int8"})
+    eng8 = InferenceEngine(bundle, cfg8, ReplicaSet(make_mesh(1)))
+    assert eng8.kv_bytes_estimate(
+        {"input_ids": np.ones(10, np.int32), "length": np.int32(10)}
+    ) < short
+
+
+# ---------------------------------------------------------------------------
+# 4. preemption with token-identical resume
+
+
+def test_interactive_preempts_batch_and_resumes_token_identical():
+    from helpers import text_feats
+    from test_streams import _echo_bundle
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = _echo_bundle()
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2, 4, 8),
+        seq_buckets=(16, 32, 64), max_decode_len=64,
+        stream_chunk_tokens=4, max_streams=1, max_stream_queue=4,
+        preempt=True,
+    )
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.admission = AdmissionController(cfg, eng)
+
+    batch_feats = text_feats(
+        bundle.tokenizer,
+        "a batch-class stream long enough to be preempted mid-generation",
+    )
+    inter_feats = text_feats(bundle.tokenizer, "quick interactive")
+    ref_batch = np.concatenate(list(eng.generate_stream(dict(batch_feats))))
+    ref_inter = np.concatenate(list(eng.generate_stream(dict(inter_feats))))
+
+    # Slow each shared chunk dispatch so the preemption window (a chunk
+    # boundary while the batch stream is mid-generation) is wide.
+    orig_chunk = eng._gen_chunk
+
+    def slow_chunk(*a, **k):
+        time.sleep(0.05)
+        return orig_chunk(*a, **k)
+
+    eng._gen_chunk = slow_chunk
+
+    async def _collect(gen):
+        out = []
+        async for c in gen:
+            out.append(np.asarray(c))
+        return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+    async def body():
+        g_b = cdl.submit_stream(dict(batch_feats, priority="batch"))
+        first = np.asarray(await g_b.__anext__())  # batch owns the slot
+        g_i = cdl.submit_stream(dict(inter_feats, priority="interactive"))
+        out_i = await _collect(g_i)
+        rest = await _collect(g_b)
+        return out_i, np.concatenate([first, rest])
+
+    try:
+        out_i, out_b = asyncio.run(body())
+    finally:
+        eng._gen_chunk = orig_chunk
+        cdl.stop()
+    assert cdl.preemptions >= 1, "interactive arrival must have preempted"
+    # The preempted stream's delivered tokens are IDENTICAL to an
+    # unpreempted run — the checkpoint/resume seam is invisible.
+    np.testing.assert_array_equal(out_b, ref_batch)
+    np.testing.assert_array_equal(out_i, ref_inter)
+
+
+def test_preempt_recast_resume_decoder_only():
+    """Decoder-only victims resume via the recast path: the checkpoint
+    folds delivered tokens into the prompt and re-enters admission as a
+    fresh (shorter-remaining) prefill — still token-identical, without
+    replaying already-delivered decode steps."""
+    from test_gpt import _tiny_bundle
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+    import dataclasses
+
+    bundle = _tiny_bundle()
+    bundle = dataclasses.replace(bundle, supports_prefix=True)
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(16, 32, 64), max_decode_len=24,
+        stream_chunk_tokens=4, max_streams=1, max_stream_queue=4,
+        preempt=True,
+    )
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.admission = AdmissionController(cfg, eng)
+
+    batch_feats = {
+        "input_ids": np.arange(5, 17, dtype=np.int32), "length": np.int32(12)
+    }
+    inter_feats = {
+        "input_ids": np.arange(30, 38, dtype=np.int32), "length": np.int32(8)
+    }
+    ref_batch = np.concatenate(list(eng.generate_stream(dict(batch_feats))))
+    ref_inter = np.concatenate(list(eng.generate_stream(dict(inter_feats))))
+
+    orig_chunk = eng._gen_chunk
+
+    def slow_chunk(*a, **k):
+        time.sleep(0.05)
+        return orig_chunk(*a, **k)
+
+    eng._gen_chunk = slow_chunk
+
+    async def _collect(gen):
+        out = []
+        async for c in gen:
+            out.append(np.asarray(c))
+        return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+    async def body():
+        g_b = cdl.submit_stream(dict(batch_feats, priority="batch"))
+        first = np.asarray(await g_b.__anext__())
+        g_i = cdl.submit_stream(dict(inter_feats, priority="interactive"))
+        out_i = await _collect(g_i)
+        rest = await _collect(g_b)
+        return out_i, np.concatenate([first, rest])
+
+    try:
+        out_i, out_b = asyncio.run(body())
+    finally:
+        eng._gen_chunk = orig_chunk
+        cdl.stop()
+    assert cdl.preemptions >= 1
+    n = min(out_b.size, ref_batch.size)
+    np.testing.assert_array_equal(out_b[:n], ref_batch[:n])
+    np.testing.assert_array_equal(out_i, ref_inter)
+
+
+# ---------------------------------------------------------------------------
+# 5. app-level: stream overload statuses + graceful drain
+
+
+def _service(cfg_kw, bundle_fn):
+    """(cfg, bundle, engine, batcher, app) on the test mesh."""
+    from mlmicroservicetemplate_tpu.api import build_app
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    base = dict(
+        device="cpu", warmup=False, batch_buckets=(1, 2, 4, 8),
+        seq_buckets=(16, 32, 64), max_decode_len=32,
+        stream_chunk_tokens=4, batch_timeout_ms=1.0,
+    )
+    base.update(cfg_kw)
+    cfg = ServiceConfig(**base)
+    bundle = bundle_fn()
+    engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    batcher = Batcher(engine, cfg)
+    app = build_app(cfg, bundle, engine, batcher)
+    return cfg, bundle, engine, batcher, app
+
+
+async def _ready(client):
+    for _ in range(200):
+        resp = await client.get("/readyz")
+        if resp.status == 200:
+            return
+        await asyncio.sleep(0.05)
+    raise RuntimeError("service never became ready")
+
+
+def test_stream_overload_503_retry_after_and_deadline_504():
+    """Stream admission under the scheduler: past capacity+queue the
+    request sheds 503 WITH Retry-After; a queued stream whose deadline
+    passes returns a real 504 (it never streamed bytes)."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from test_streams import _echo_bundle
+
+    def app_echo_bundle():
+        # The echo bundle carries no model cfg; the API's delta decoder
+        # only needs eos/pad ids (ByteTokenizer: eos=1, pad=0).
+        bundle = _echo_bundle()
+        bundle.cfg = SimpleNamespace(eos_id=1, pad_id=0)
+        return bundle
+
+    async def main():
+        _, _, engine, _, app = _service(
+            dict(max_streams=1, max_stream_queue=1, max_decode_len=64,
+                 preempt=False),
+            app_echo_bundle,
+        )
+        orig_chunk = engine._gen_chunk
+
+        def slow_chunk(*a, **k):
+            time.sleep(0.05)
+            return orig_chunk(*a, **k)
+
+        engine._gen_chunk = slow_chunk
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await _ready(client)
+            prompt = "a long enough stream to hold the slot for a while yes"
+            # A holds the single slot (200, streaming).
+            resp_a = await client.post(
+                "/predict", json={"text": prompt, "stream": True},
+                headers={"X-Priority": "batch"},
+            )
+            assert resp_a.status == 200
+            # B takes the single wait-queue seat, with a deadline.
+            task_b = asyncio.ensure_future(client.post(
+                "/predict", json={"text": prompt, "stream": True},
+                headers={"X-Priority": "batch", "X-Deadline-Ms": "150"},
+            ))
+            await asyncio.sleep(0.03)
+            # C outranks nobody (same class, no deadline): 503 + header.
+            resp_c = await client.post(
+                "/predict", json={"text": prompt, "stream": True},
+                headers={"X-Priority": "batch"},
+            )
+            assert resp_c.status == 503
+            assert int(resp_c.headers["Retry-After"]) >= 1
+            # B's deadline passes while queued: fast 504.
+            resp_b = await task_b
+            assert resp_b.status == 504
+            # A still completes intact.
+            lines = (await resp_a.text()).strip().splitlines()
+            assert json.loads(lines[-1]).get("done") is True
+            # Shed accounting + TTFT exported at /metrics.
+            body = await (await client.get("/metrics")).text()
+            assert "requests_shed_total" in body
+            assert "stream_ttft_seconds" in body
+        finally:
+            engine._gen_chunk = orig_chunk
+            await client.close()
+
+    asyncio.run(main())
+
+
+def test_drain_rejects_new_and_finishes_inflight():
+    """begin_drain (the SIGTERM path): readyz flips 503, new work sheds
+    503 ``drain`` with Retry-After, the in-flight stream runs to
+    completion, and drained() confirms quiescence."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from helpers import tiny_t5_bundle
+    from mlmicroservicetemplate_tpu.api.app import drain_app
+
+    async def main():
+        _, _, _, batcher, app = _service(
+            dict(max_streams=2, max_stream_queue=4), tiny_t5_bundle
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await _ready(client)
+            resp_stream = await client.post(
+                "/predict",
+                json={"text": "summarize: drain in flight", "stream": True},
+            )
+            assert resp_stream.status == 200
+            drain_task = asyncio.ensure_future(drain_app(app, grace_s=20.0))
+            await asyncio.sleep(0.02)
+            # New work sheds 503 drain with Retry-After...
+            late = await client.post(
+                "/predict", json={"text": "summarize: late"}
+            )
+            assert late.status == 503
+            assert "Retry-After" in late.headers
+            # ...liveness stays green, readiness flips (LB stops routing).
+            hz = await client.get("/healthz")
+            assert hz.status == 200 and (await hz.json())["draining"]
+            rz = await client.get("/readyz")
+            assert rz.status == 503 and (await rz.json())["draining"]
+            # The admitted stream still finishes completely.
+            lines = (await resp_stream.text()).strip().splitlines()
+            assert json.loads(lines[-1]).get("done") is True
+            assert await drain_task is True
+            assert batcher.pending_work() == 0
+        finally:
+            await client.close()
+
+    asyncio.run(main())
