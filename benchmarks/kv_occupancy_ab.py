@@ -1,0 +1,153 @@
+"""KV-occupancy A/B: paged vs contiguous admission at a FIXED KV budget.
+
+The judged claim (ISSUE 3): with ``PAGED_KV=1`` at fixed
+``KV_BUDGET_MB``, a mixed-length streaming workload runs MORE streams
+concurrently than the contiguous layout — because the contiguous
+ledger charges every stream its prompt bucket + the FULL server decode
+budget for its whole lifetime, while the paged ledger charges prompt
+blocks + one chunk and grows block-by-block, freeing on EOS.
+
+Two arms over the same gpt2 service (random-init weights — occupancy
+and throughput depend on shapes, not weights):
+
+- **contig**: ``PAGED_KV=0`` + ``KV_BUDGET_MB`` (the round-7 ceiling
+  ledger gates dequeue).
+- **paged**: ``PAGED_KV=1`` + the same budget (exact block ledger).
+
+N streams with mixed prompt lengths and small per-request max_tokens
+arrive at once and wait in a deep stream queue; the KV ledger is the
+only thing gating how many decode concurrently.  Reported per arm:
+peak concurrent streams (max overlap of [first-token, done]
+intervals), total wall time, aggregate tokens/s, sheds.
+
+    python benchmarks/kv_occupancy_ab.py              # current backend
+    DEVICE=cpu python benchmarks/kv_occupancy_ab.py   # CPU sanity run
+
+One JSON line per arm to stdout, a markdown table to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+from harness import ServiceUnderTest  # noqa: E402
+
+N_STREAMS = int(os.environ.get("KV_AB_N", "12"))
+BUDGET_MB = float(os.environ.get("KV_AB_BUDGET_MB", "16"))
+# Mixed lengths: mostly short chats, some longer prompts — the shape
+# where worst-case reservations waste the most budget.  Lengths are
+# CHARACTER counts (the byte-fallback tokenizer is 1 token/char) and
+# all fit the largest seq bucket so every stream rides the continuous
+# loop, where both ledgers bind.
+PROMPTS = [
+    ("short", "the quick fox", 4),
+    ("short", "a tiny prompt", 6),
+    ("medium", "a medium prompt in the larger bucket....", 8),
+    ("long", "a longer prompt that fills most of the big seq bucket :)", 16),
+]
+
+
+async def _one(client, i: int):
+    kind, text, max_tokens = PROMPTS[i % len(PROMPTS)]
+    t0 = time.perf_counter()
+    try:
+        resp = await client.post(
+            "/predict",
+            json={"text": text, "stream": True, "max_tokens": max_tokens},
+        )
+        if resp.status != 200:
+            await resp.read()
+            return {"kind": kind, "status": resp.status}
+        ttft = None
+        n_tok = 0
+        async for line in resp.content:
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            row = json.loads(line)
+            if row.get("done"):
+                n_tok = int(row.get("tokens_generated", 0))
+                break
+        return {
+            "kind": kind, "status": 200, "t_first": t0 + (ttft or 0.0),
+            "t_end": time.perf_counter(), "tokens": n_tok,
+        }
+    except Exception:
+        return {"kind": kind, "status": -1}
+
+
+def _peak_overlap(rows: list[dict]) -> int:
+    events = []
+    for r in rows:
+        if r.get("status") == 200 and "t_first" in r:
+            events.append((r["t_first"], 1))
+            events.append((r["t_end"], -1))
+    events.sort()
+    peak = cur = 0
+    for _, d in events:
+        cur += d
+        peak = max(peak, cur)
+    return peak
+
+
+async def run_arm(paged: bool, dev: dict) -> dict:
+    overrides = {
+        "MODEL_NAME": "gpt2",
+        "BATCH_BUCKETS": "1,4",
+        "SEQ_BUCKETS": "32,64",
+        "MAX_DECODE_LEN": "32",
+        "MAX_STREAMS": "8",
+        "MAX_STREAM_QUEUE": "16",
+        "KV_BUDGET_MB": str(BUDGET_MB),
+        "PAGED_KV": "1" if paged else "0",
+        "KV_BLOCK_SIZE": "16",
+        **dev,
+    }
+    async with ServiceUnderTest(overrides) as s:
+        t0 = time.perf_counter()
+        rows = await asyncio.gather(
+            *(_one(s.client, i) for i in range(N_STREAMS))
+        )
+        wall = time.perf_counter() - t0
+        served = [r for r in rows if r.get("status") == 200]
+        toks = sum(r.get("tokens", 0) for r in served)
+        return {
+            "arm": "paged" if paged else "contig",
+            "budget_mb": BUDGET_MB,
+            "offered": N_STREAMS,
+            "served": len(served),
+            "peak_concurrent": _peak_overlap(rows),
+            "wall_s": round(wall, 2),
+            "tokens_per_s": round(toks / wall, 1),
+            "shed": sum(1 for r in rows if r.get("status") not in (200,)),
+        }
+
+
+async def main() -> None:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    rows = [await run_arm(False, dev), await run_arm(True, dev)]
+
+    import jax
+
+    backend = jax.default_backend()
+    print("\n| arm | served | peak concurrent | wall (s) | tokens/s "
+          "| shed |", file=sys.stderr)
+    print("|---|---|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(
+            f"| {r['arm']} | {r['served']}/{r['offered']} "
+            f"| {r['peak_concurrent']} | {r['wall_s']} "
+            f"| {r['tokens_per_s']} | {r['shed']} |",
+            file=sys.stderr,
+        )
+        print(json.dumps({**r, "backend": backend}))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -42,14 +42,30 @@ PROMPT = "summarize: the quick brown fox jumps over the lazy dog again"
 LOADS = (1.0, 2.0, 4.0)
 N_PER_ARM = int(os.environ.get("OVERLOAD_N", "48"))
 
+# Load shapes (round-8 satellite): round 7's single shape (12-deep
+# queue, 2.5× deadline, deadlines on interactive only) always hit the
+# queue BOUND before any waiter aged out, so its 504 column was
+# structurally zero — and class-weighted dequeue serves interactive
+# fast enough that a loose deadline never lapses in the queue.  The
+# "deep" shape — deeper queue, ~solo-tight deadline, deadlines on
+# BOTH classes, overload only — lets waiters age out INSIDE the
+# queue, exercising the fast-504 path in the table (not just in unit
+# tests).  Fields: (name, queue depth, deadline factor, deadline on
+# both classes, loads).  OVERLOAD_SHAPES filters.
+SHAPES = (
+    ("base", "12", 2.5, False, LOADS),
+    ("deep", "24", 1.2, True, (4.0,)),
+)
 
-async def _one(client, i: int, sched: bool, deadline_ms: float):
+
+async def _one(client, i: int, sched: bool, deadline_ms: float,
+               deadline_all: bool = False):
     """One streamed request; returns (klass, status, ttft_s, wall_s)."""
     klass = "interactive" if i % 2 == 0 else "batch"
     headers = {}
     if sched:
         headers["X-Priority"] = klass
-        if klass == "interactive":
+        if klass == "interactive" or deadline_all:
             headers["X-Deadline-Ms"] = str(int(deadline_ms))
     t0 = time.perf_counter()
     try:
@@ -71,14 +87,17 @@ async def _one(client, i: int, sched: bool, deadline_ms: float):
         return klass, -1, None, None
 
 
-async def run_arm(s, sched: bool, rate_sps: float, deadline_ms: float):
+async def run_arm(s, sched: bool, rate_sps: float, deadline_ms: float,
+                  deadline_all: bool = False):
     """Offered load at ``rate_sps`` arrivals/s, 50/50 class mix.
     Returns raw per-arm tallies; cells aggregate across repeats."""
     tasks = []
     interval = 1.0 / rate_sps
     t0 = time.perf_counter()
     for i in range(N_PER_ARM):
-        tasks.append(asyncio.create_task(_one(s.client, i, sched, deadline_ms)))
+        tasks.append(asyncio.create_task(
+            _one(s.client, i, sched, deadline_ms, deadline_all)
+        ))
         await asyncio.sleep(interval)
     results = await asyncio.gather(*tasks)
     wall = time.perf_counter() - t0  # makespan: arrivals + drain tail
@@ -96,12 +115,18 @@ async def run_arm(s, sched: bool, rate_sps: float, deadline_ms: float):
     }
 
 
-async def main() -> None:
-    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+async def run_shape(shape: str, queue_depth: str, deadline_factor: float,
+                    deadline_all: bool, loads, dev: dict,
+                    rows: list) -> None:
     overrides = {
         "MODEL_NAME": "t5-small",
         "BATCH_BUCKETS": "1,4",
-        "SEQ_BUCKETS": "32",
+        # The prompt byte-tokenizes to 61 tokens: the max seq bucket
+        # must COVER it or every stream silently routes to the legacy
+        # per-stream path, where the deadline queue, priorities and
+        # preemption never bind (round 7 ran with SEQ_BUCKETS=32 and
+        # measured exactly that — recorded in BASELINE.md r8).
+        "SEQ_BUCKETS": "32,64",
         "MAX_DECODE_LEN": "8",
         # Narrow slot pool + deep wait queue: time spent waiting lands
         # in the SCHEDULABLE queue (where EDF/priorities/expiry bind)
@@ -110,11 +135,10 @@ async def main() -> None:
         # backend (slots beyond the parallelism the chip actually has
         # only dilute every stream's cadence).
         "MAX_STREAMS": "2",
-        "MAX_STREAM_QUEUE": "12",
+        "MAX_STREAM_QUEUE": queue_depth,
         "CLASS_WEIGHT": "4",
         **dev,
     }
-    rows = []
     async with ServiceUnderTest(overrides) as s:
         # Capacity calibration: how fast the slot pool ACTUALLY drains
         # a full concurrent wave (on a shared-core CPU host the slots
@@ -135,9 +159,11 @@ async def main() -> None:
             )
         capacity_sps = waves * 2 / (time.perf_counter() - t0)
         # Deadline budget: a promptly-served request fits comfortably
-        # (~2.5× a solo run); one that waited out an overloaded FIFO
-        # queue does not — that's the SLA the scheduler defends.
-        deadline_ms = max(2.5 * solo_s * 1e3, 200.0)
+        # (the base shape's 2.5× a solo run); one that waited out an
+        # overloaded FIFO queue does not — that's the SLA the
+        # scheduler defends.  The "deep" shape tightens the factor so
+        # deep-queued waiters age out IN the queue (the 504 path).
+        deadline_ms = max(deadline_factor * solo_s * 1e3, 200.0)
         # Repeats with arm-order alternation: on a shared-core host the
         # run-to-run variance rivals the effect size, so each (load,
         # arm) cell aggregates across repeats and neither arm always
@@ -145,11 +171,12 @@ async def main() -> None:
         repeats = int(os.environ.get("OVERLOAD_REPEATS", "2"))
         cells: dict = {}
         for rep in range(repeats):
-            for mult in LOADS:
+            for mult in loads:
                 arm_order = (False, True) if rep % 2 == 0 else (True, False)
                 for sched in arm_order:
                     r = await run_arm(
-                        s, sched, capacity_sps * mult, deadline_ms
+                        s, sched, capacity_sps * mult, deadline_ms,
+                        deadline_all,
                     )
                     c = cells.setdefault((mult, r["arm"]), {
                         "offered": 0, "good": 0, "wall": 0.0,
@@ -162,6 +189,7 @@ async def main() -> None:
                     await asyncio.sleep(1.0)  # drain the slot pool
         for (mult, arm), c in sorted(cells.items()):
             rows.append({
+                "shape": shape,
                 "load_x": mult,
                 "arm": arm,
                 "interactive_offered": c["offered"],
@@ -177,15 +205,31 @@ async def main() -> None:
                 "deadline_ms": round(deadline_ms, 1),
             })
 
+
+async def main() -> None:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    want = tuple(
+        s.strip()
+        for s in os.environ.get("OVERLOAD_SHAPES", "base,deep").split(",")
+        if s.strip()
+    )
+    rows: list = []
+    for shape, queue_depth, factor, deadline_all, loads in SHAPES:
+        if shape in want:
+            await run_shape(
+                shape, queue_depth, factor, deadline_all, loads, dev, rows
+            )
+
     import jax
 
     backend = jax.default_backend()
-    print("\n| load | arm | goodput (rps) | in-deadline | ttft p99 (ms) "
-          "| 503 | 504 |", file=sys.stderr)
-    print("|---|---|---|---|---|---|---|", file=sys.stderr)
+    print("\n| shape | load | arm | goodput (rps) | in-deadline "
+          "| ttft p99 (ms) | 503 | 504 |", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|---|", file=sys.stderr)
     for r in rows:
         print(
-            f"| {r['load_x']}x | {r['arm']} | {r['interactive_goodput_rps']} "
+            f"| {r['shape']} | {r['load_x']}x | {r['arm']} "
+            f"| {r['interactive_goodput_rps']} "
             f"| {r['interactive_in_deadline']}/{r['interactive_offered']} "
             f"| {r['ttft_p99_ms']} | {r['shed_503']} | {r['shed_504']} |",
             file=sys.stderr,

@@ -122,6 +122,15 @@ async def main() -> None:
             check=False,
         )
 
+    # Paged-KV occupancy (round-8 tentpole): max concurrent streams +
+    # decode throughput at fixed KV_BUDGET_MB, exact block ledger vs
+    # the contiguous ceiling.  KV_AB=0 skips.
+    if os.environ.get("KV_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "kv_occupancy_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

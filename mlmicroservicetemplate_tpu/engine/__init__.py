@@ -9,3 +9,4 @@ static KV cache instead of a per-token Python loop (§7.4.2).
 """
 
 from .engine import InferenceEngine, bucket_for  # noqa: F401
+from .kv_blocks import BlockPool, OutOfBlocks, StreamBlocks, blocks_for  # noqa: F401

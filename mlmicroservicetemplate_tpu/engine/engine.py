@@ -209,6 +209,40 @@ class InferenceEngine:
 
                 self._full_spec = jax.jit(full_spec, static_argnums=(5, 6, 7))
 
+            # Block-paged KV (PAGED_KV=1, decoder families): the
+            # continuous loop's KV lives in a pool of KV_BLOCK_SIZE-
+            # token blocks (engine/kv_blocks.py) instead of per-slot
+            # contiguous slabs; the pool is sized from KV_BUDGET_MB
+            # (or MAX_STREAMS × worst case when no budget is set) and
+            # is the single source of truth for committed KV bytes.
+            self.paged_kv = bool(
+                getattr(cfg, "paged_kv", False)
+                and bundle.paged_chunk_fn is not None
+            )
+            self.kv_block_size = int(getattr(cfg, "kv_block_size", 16))
+            self.kv_pool = None
+            if self.paged_kv:
+                from .kv_blocks import BlockPool, blocks_for
+
+                if self.replicas.pad_multiple() != 1:
+                    raise ValueError(
+                        "PAGED_KV requires a single-replica placement "
+                        "(the block pool has no batch axis to shard)"
+                    )
+                bb = self.kv_block_bytes()
+                budget = int(
+                    float(getattr(cfg, "kv_budget_mb", 0.0) or 0.0) * 1e6
+                )
+                if budget:
+                    num = max(1, budget // bb)
+                else:
+                    worst = blocks_for(
+                        max(self.seq_buckets) + self.max_decode_len,
+                        self.kv_block_size,
+                    )
+                    num = max(1, int(getattr(cfg, "max_streams", 8))) * worst
+                self.kv_pool = BlockPool(num, bb)
+
             # Per-request prefix cache (PREFIX_CACHE=1, decoder
             # families without a global PROMPT_PREFIX): recurring
             # prompt prefixes — per-conversation system prompt +
@@ -228,8 +262,20 @@ class InferenceEngine:
             ):
                 from .prefix_cache import PrefixCache
 
+                # Paged mode stores block-ref pins, not KV copies:
+                # eviction must release the cache's pool ref.
+                on_evict = None
+                if self.paged_kv:
+                    def on_evict(entry):
+                        from .kv_blocks import PagedPrefix
+
+                        if isinstance(entry, PagedPrefix):
+                            self.kv_pool.free(list(entry.block_ids))
+
                 self.prefix_cache = PrefixCache(
-                    self.seq_buckets, float(getattr(cfg, "prefix_cache_mb", 256.0))
+                    self.seq_buckets,
+                    float(getattr(cfg, "prefix_cache_mb", 256.0)),
+                    on_evict=on_evict,
                 )
 
                 def start_prefixed(p, pkv, ids, mask, sp, max_len: int,
@@ -299,6 +345,9 @@ class InferenceEngine:
             self.spec_enabled = False
             self.spec_sampled = False
             self.prefix_cache = None
+            self.paged_kv = False
+            self.kv_block_size = int(getattr(cfg, "kv_block_size", 16))
+            self.kv_pool = None
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
         self.last_decode_steps: int | None = None
@@ -380,26 +429,11 @@ class InferenceEngine:
             int(feats.get("max_tokens", self.max_decode_len)), self.max_decode_len
         )
 
-    def kv_bytes_estimate(self, feats: dict) -> int:
-        """Admission-time estimate of one request's KV-cache footprint
-        in bytes: padded prompt bucket + server decode budget wide,
-        model dims off the bundle config, element width off the active
-        QUANT_KV mode (int8 payload + one f32 scale per token-head vs
-        the compute dtype).  Encoder-decoder families add the
-        cross-attention cache over the encoder bucket.
-
-        Deliberately a ceiling (collation pads up to buckets, the full
-        decode budget is reserved even if the row EOSes early), so the
-        scheduler's HBM budget fails SAFE — overcommit is refused at
-        admission instead of discovered at slot-insert."""
-        if self.bundle.kind != KIND_SEQ2SEQ:
-            return 0
+    def _kv_dims(self) -> tuple[int, int, int, int, bool]:
+        """(layers, kv_heads, head_dim, elt_bytes, quant_int8) off the
+        bundle config — the one place the admission estimate and the
+        paged block ledger read model dims, so they can never drift."""
         cfg = self.bundle.cfg
-        s = bucket_for(
-            max(int(feats.get("length", 0) or 0), 1),
-            self.seq_buckets, self.replicas.seq_multiple(),
-        )
-        width = s + self.max_decode_len
         layers = int(getattr(cfg, "num_layers", 0) or 12)
         heads = int(
             getattr(cfg, "num_kv_heads", 0)
@@ -412,19 +446,93 @@ class InferenceEngine:
             d_model = int(getattr(cfg, "d_model", 0) or 768)
             n_attn = int(getattr(cfg, "num_heads", 0) or heads)
             head_dim = max(1, d_model // max(1, n_attn))
-        if getattr(self.cfg, "quant_kv", None) == "int8":
-            per_tok_head = int(head_dim) * 1 + 4  # int8 + f32 scale
-        else:
-            try:
-                elt = np.dtype(self.bundle.policy.compute_jnp).itemsize
-            except Exception:
-                elt = 2
-            per_tok_head = int(head_dim) * elt
-        total = 2 * layers * heads * width * per_tok_head
+        quant = getattr(self.cfg, "quant_kv", None) == "int8"
+        try:
+            elt = np.dtype(self.bundle.policy.compute_jnp).itemsize
+        except Exception:
+            elt = 2
+        return layers, heads, int(head_dim), int(elt), quant
+
+    def _global_prefix_len(self) -> int:
+        """Token rows a global PROMPT_PREFIX occupies in EVERY stream's
+        cache (0 without one)."""
+        pre = (
+            self.bundle.params.get("__prefix__")
+            if isinstance(self.bundle.params, dict) else None
+        )
+        if pre is None:
+            return 0
+        entry = pre["k"][0]
+        return int(
+            entry[0].shape[1] if isinstance(entry, tuple) else entry.shape[1]
+        )
+
+    def kv_token_bytes(self) -> int:
+        """KV bytes one token position costs in this deployment."""
+        from .kv_blocks import kv_token_bytes
+
+        layers, heads, head_dim, elt, quant = self._kv_dims()
+        return kv_token_bytes(layers, heads, head_dim, elt, quant)
+
+    def kv_block_bytes(self) -> int:
+        """Bytes one ``KV_BLOCK_SIZE``-token block costs (paged mode)."""
+        return self.kv_token_bytes() * self.kv_block_size
+
+    def kv_bytes_estimate(self, feats: dict) -> int:
+        """Admission-time estimate of one request's KV-cache footprint
+        in bytes: padded prompt bucket + server decode budget wide (a
+        global PROMPT_PREFIX adds its rows — every stream's cache
+        physically carries them), model dims off the bundle config,
+        element width off the active QUANT_KV mode (int8 payload + one
+        f32 scale per token-head vs the compute dtype).  Encoder-
+        decoder families add the cross-attention cache over the
+        encoder bucket.  Decoder-only causal LMs (gpt2/llama) register
+        as KIND_SEQ2SEQ, so they take this path too — pinned by test,
+        since a 0 here silently no-ops KV admission for the families
+        that carry the composed decode levers.
+
+        Deliberately a ceiling (collation pads up to buckets, the full
+        decode budget is reserved even if the row EOSes early), so the
+        scheduler's HBM budget fails SAFE — overcommit is refused at
+        admission instead of discovered at slot-insert.  Paged mode
+        replaces this ceiling with the exact block ledger
+        (``kv_blocks_estimate``); the invariant the property test pins
+        is ceiling ≥ blocks × block bytes."""
+        if self.bundle.kind != KIND_SEQ2SEQ:
+            return 0
+        cfg = self.bundle.cfg
+        s = bucket_for(
+            max(int(feats.get("length", 0) or 0), 1),
+            self.seq_buckets, self.replicas.seq_multiple(),
+        )
+        width = self._global_prefix_len() + s + self.max_decode_len
+        per_tok = self.kv_token_bytes()
+        total = width * per_tok
         if getattr(cfg, "d_kv", None) is not None:
             # Encoder-decoder: cross-attention K/V over the encoder seq.
-            total += 2 * layers * heads * s * per_tok_head
+            total += s * per_tok
         return int(total)
+
+    def kv_blocks_estimate(self, feats: dict) -> tuple[int, int]:
+        """Paged mode's exact ledger: (initial, worst) block counts for
+        one stream.  ``initial`` covers the prompt bucket plus the
+        fused first chunk — what admission charges up front; the loop
+        grows block-by-block from there.  ``worst`` covers the
+        request's own decode budget (max_tokens, chunk-rounded) — the
+        can-never-fit rejection bound."""
+        from .kv_blocks import blocks_for
+
+        s = bucket_for(
+            max(int(feats.get("length", 0) or 0), 1),
+            self.seq_buckets, self.replicas.seq_multiple(),
+        )
+        budget = int(
+            math.ceil(self.budget_for(feats) / self.chunk_tokens)
+            * self.chunk_tokens
+        )
+        initial = blocks_for(s + self.chunk_tokens, self.kv_block_size)
+        worst = blocks_for(s + budget, self.kv_block_size)
+        return initial, max(initial, worst)
 
     def _collate_budget(self, feats: list[dict], bsz: int) -> np.ndarray:
         """Per-row budgets for the batched non-stream path; pad rows 0."""
@@ -535,8 +643,13 @@ class InferenceEngine:
         row_ids = np.asarray(feats["input_ids"], np.int32)[: int(feats["length"])]
         length = int(feats["length"])
         usable = self._prefix_guard(length)
-        if self.prefix_cache is not None:
-            m = self.prefix_cache.match(row_ids, length, usable=usable)
+        # Paged mode: the cache holds block-ref pins owned by the
+        # continuous loop's pool — this per-stream path (oversized
+        # prompts, spec routing, CONTINUOUS_BATCHING=0) stays
+        # contiguous and must neither consume nor pollute them.
+        prefix_cache = None if self.paged_kv else self.prefix_cache
+        if prefix_cache is not None:
+            m = prefix_cache.match(row_ids, length, usable=usable)
             if m is not None:
                 p_len, pkv = m
                 sfeats = dict(
@@ -556,13 +669,13 @@ class InferenceEngine:
                 # KV, so capture at the LARGEST bucket this prompt now
                 # covers — otherwise turn N stays pinned to turn 1's
                 # bucket and re-prefills an ever-growing suffix.
-                p_ins = self.prefix_cache.bucket_for_insert(length)
+                p_ins = prefix_cache.bucket_for_insert(length)
                 if (
                     p_ins is not None
                     and p_ins > p_len
-                    and not self.prefix_cache.contains(row_ids, p_ins)
+                    and not prefix_cache.contains(row_ids, p_ins)
                 ):
-                    self.prefix_cache.insert(
+                    prefix_cache.insert(
                         row_ids, p_ins, self._capture_prefix(state, p_ins)
                     )
                 return state, toks, sampled
@@ -573,12 +686,12 @@ class InferenceEngine:
             self.params, ids, mask, sp,
             self.max_decode_len, self.chunk_tokens, sampled,
         )
-        if self.prefix_cache is not None:
-            p_ins = self.prefix_cache.bucket_for_insert(length)
-            if p_ins is not None and not self.prefix_cache.contains(
+        if prefix_cache is not None:
+            p_ins = prefix_cache.bucket_for_insert(length)
+            if p_ins is not None and not prefix_cache.contains(
                 row_ids, p_ins
             ):
-                self.prefix_cache.insert(
+                prefix_cache.insert(
                     row_ids, p_ins, self._capture_prefix(state, p_ins)
                 )
         return state, toks, sampled
@@ -698,10 +811,13 @@ class InferenceEngine:
         # Static executable variant: rejection-sampling acceptance for
         # temperature>0 requests (generate_stream gated on spec_sampled).
         sampled = float(feats.get("temperature", 0.0)) > 0.0
+        # Same paged-mode bypass as start_fused: block-ref pins belong
+        # to the continuous loop's pool, not this contiguous path.
+        prefix_cache = None if self.paged_kv else self.prefix_cache
         with self._lock:
             hit = None
-            if self.prefix_cache is not None:
-                hit = self.prefix_cache.match(
+            if prefix_cache is not None:
+                hit = prefix_cache.match(
                     row_ids, length, usable=self._prefix_guard(length)
                 )
             if hit is not None:
@@ -721,13 +837,13 @@ class InferenceEngine:
                 # Growing conversations keep donating from the hit
                 # path (same rule as start_fused): capture the largest
                 # bucket this prompt now covers.
-                p_ins = self.prefix_cache.bucket_for_insert(length)
+                p_ins = prefix_cache.bucket_for_insert(length)
                 if (
                     p_ins is not None
                     and p_ins > p_len
-                    and not self.prefix_cache.contains(row_ids, p_ins)
+                    and not prefix_cache.contains(row_ids, p_ins)
                 ):
-                    self.prefix_cache.insert(
+                    prefix_cache.insert(
                         row_ids, p_ins, self._capture_prefix(ss.base, p_ins)
                     )
             else:
@@ -738,12 +854,12 @@ class InferenceEngine:
                     self.params, ids, mask, sp,
                     self.max_decode_len, n_verify, self.spec_k, sampled,
                 )
-                if self.prefix_cache is not None:
-                    p_ins = self.prefix_cache.bucket_for_insert(length)
-                    if p_ins is not None and not self.prefix_cache.contains(
+                if prefix_cache is not None:
+                    p_ins = prefix_cache.bucket_for_insert(length)
+                    if p_ins is not None and not prefix_cache.contains(
                         row_ids, p_ins
                     ):
-                        self.prefix_cache.insert(
+                        prefix_cache.insert(
                             row_ids, p_ins,
                             self._capture_prefix(ss.base, p_ins),
                         )

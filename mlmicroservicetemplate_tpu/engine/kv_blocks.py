@@ -1,0 +1,210 @@
+"""Block-paged KV-cache bookkeeping: free-list allocator, per-stream
+block tables, refcounts, copy-on-write prefix sharing.
+
+The contiguous layout reserves ``seq_bucket + MAX_DECODE_LEN`` KV rows
+per slot for a stream's whole lifetime, so concurrency under
+``KV_BUDGET_MB`` is bounded by the WORST case.  Paged mode
+(``PAGED_KV=1``) carves the budget into fixed-size token blocks
+(``KV_BLOCK_SIZE``) and accounts at block granularity instead:
+
+- a stream is admitted holding only its prompt blocks plus the blocks
+  the first chunk needs,
+- it grows block-by-block at chunk boundaries as decode proceeds,
+- every block returns to the free list the moment the stream finishes
+  (early EOS, cancel, preemption checkpoint) — not at slot release.
+
+Everything here is HOST-side: block ids index the device-resident
+pools (``models/gpt.PagedState``); the tables ride into each dispatch
+as a traced int32 array.  The allocator is the single source of truth
+for committed KV bytes in paged mode (``scheduler/admission.py`` reads
+it instead of running its own ceiling ledger).
+
+Copy-on-write prefix sharing: KV is append-only, so "CoW" degenerates
+to pure sharing — a prefix-cache hit pins the donor's prompt blocks by
+refcount (no copy; the sharer never writes positions < P because
+prefix lengths are block-aligned seq buckets), and a block is freed
+only when its LAST holder (streams and the cache pin alike) derefs it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` KV rows (ceil; 0 for 0)."""
+    if tokens <= 0:
+        return 0
+    return -(-int(tokens) // int(block_size))
+
+
+class OutOfBlocks(Exception):
+    """The pool cannot satisfy an allocation (caller reclaims/preempts)."""
+
+
+class BlockPool:
+    """Thread-safe free-list allocator with per-block refcounts.
+
+    ``alloc`` hands out blocks at refcount 1; ``ref`` adds holders
+    (CoW prefix sharing: the cache pin and every sharer each hold one
+    ref); ``free`` drops one ref per id and returns a block to the
+    free list when its count hits zero.  All-or-nothing: a partial
+    allocation never leaks."""
+
+    def __init__(self, num_blocks: int, block_bytes: int = 0):
+        self.num_blocks = int(num_blocks)
+        self.block_bytes = int(block_bytes)
+        self._free: deque[int] = deque(range(self.num_blocks))
+        self._ref: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    # -- queries -------------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    @property
+    def used_bytes(self) -> int:
+        return self.used_blocks * self.block_bytes
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    # -- mutation ------------------------------------------------------
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` blocks (refcount 1 each) or raise ``OutOfBlocks``
+        without taking any."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._free) < n:
+                raise OutOfBlocks(
+                    f"need {n} blocks, {len(self._free)} free of "
+                    f"{self.num_blocks}"
+                )
+            ids = [self._free.popleft() for _ in range(n)]
+            for b in ids:
+                self._ref[b] = 1
+            return ids
+
+    def ref(self, ids: list[int]) -> None:
+        """Add one holder to each block (shared-prefix pin)."""
+        with self._lock:
+            for b in ids:
+                if self._ref.get(b, 0) <= 0:
+                    raise ValueError(f"ref of unallocated block {b}")
+                self._ref[b] += 1
+
+    def free(self, ids: list[int]) -> None:
+        """Drop one holder per id; zero-ref blocks rejoin the free
+        list.  Unknown/already-free ids raise (a double free is a
+        ledger bug, never silently absorbed)."""
+        with self._lock:
+            for b in ids:
+                c = self._ref.get(b, 0)
+                if c <= 0:
+                    raise ValueError(f"double free of block {b}")
+                if c == 1:
+                    del self._ref[b]
+                    self._free.append(b)
+                else:
+                    self._ref[b] = c - 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            shared = sum(1 for c in self._ref.values() if c > 1)
+            return {
+                "num_blocks": self.num_blocks,
+                "free": len(self._free),
+                "used": self.num_blocks - len(self._free),
+                "shared": shared,
+            }
+
+
+@dataclass
+class StreamBlocks:
+    """One stream's block table: ids in logical-position order.
+
+    The first ``shared`` entries are CoW prefix blocks adopted from a
+    donor (this stream holds one ref on each, like any other holder);
+    the rest were alloc'd for this stream.  ``release`` derefs
+    everything exactly once."""
+
+    pool: BlockPool
+    block_size: int
+    ids: list[int] = field(default_factory=list)
+    shared: int = 0
+    released: bool = False
+
+    @property
+    def tokens_capacity(self) -> int:
+        return len(self.ids) * self.block_size
+
+    def adopt(self, shared_ids: list[int]) -> None:
+        """Prepend a donor's prefix blocks (caller guarantees the
+        logical prefix is block-aligned).  Takes one ref per block."""
+        if self.ids:
+            raise ValueError("adopt must precede any allocation")
+        self.pool.ref(shared_ids)
+        self.ids = list(shared_ids)
+        self.shared = len(shared_ids)
+
+    def ensure(self, n_tokens: int) -> list[int]:
+        """Grow the table to cover ``n_tokens`` positions; returns the
+        newly-allocated ids ([] when already covered).  Raises
+        ``OutOfBlocks`` leaving the table unchanged."""
+        need = blocks_for(n_tokens, self.block_size) - len(self.ids)
+        if need <= 0:
+            return []
+        fresh = self.pool.alloc(need)
+        self.ids.extend(fresh)
+        return fresh
+
+    def release(self) -> None:
+        if not self.released:
+            self.released = True
+            if self.ids:
+                self.pool.free(self.ids)
+            self.ids = []
+            self.shared = 0
+
+
+@dataclass(frozen=True)
+class PagedPrefix:
+    """A prefix-cache entry in paged mode: no KV copy, just the
+    donor's prompt-block ids with one pool ref held by the cache (the
+    CoW pin).  Sharers take their own ref at adoption; eviction drops
+    only the cache's ref, so in-flight sharers keep the blocks alive.
+    ``nbytes`` feeds the cache's byte budget (the bytes these pinned
+    blocks occupy in the POOL — pins spend serving budget, which is
+    exactly the trade the LRU bounds)."""
+
+    p_len: int
+    block_ids: tuple[int, ...]
+    nbytes: int
+
+
+def kv_token_bytes(
+    layers: int, kv_heads: int, head_dim: int, elt_bytes: int,
+    quant_int8: bool = False, scale_bytes: int = 4,
+) -> int:
+    """KV bytes per token position: K and V across all layers, at the
+    cache element width (int8 payload + one scale per token-head under
+    QUANT_KV=int8).  Shared by the admission estimate and the paged
+    block ledger so the two accountings can never drift."""
+    if quant_int8:
+        per_head = head_dim * 1 + scale_bytes
+    else:
+        per_head = head_dim * elt_bytes
+    return 2 * layers * kv_heads * per_head

@@ -61,7 +61,8 @@ class PrefixCache:
     """LRU {(P, hash(tokens[:P])) -> per-layer KV pytree [1, P, H, D]
     (dense) or ([1, P, H, D] int8, [1, P, H, 1] scale) under QUANT_KV}."""
 
-    def __init__(self, buckets: tuple[int, ...], budget_mb: float = 256.0):
+    def __init__(self, buckets: tuple[int, ...], budget_mb: float = 256.0,
+                 on_evict=None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.budget_bytes = int(budget_mb * 1e6)
         self._entries: OrderedDict[tuple[int, bytes], Any] = OrderedDict()
@@ -69,6 +70,11 @@ class PrefixCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # Paged mode: entries are kv_blocks.PagedPrefix block-ref pins,
+        # not KV copies; eviction must DROP the pin (pool refcount),
+        # which this callback does.  Refcounting keeps eviction safe
+        # for in-flight sharers — they hold their own refs.
+        self.on_evict = on_evict
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -108,28 +114,48 @@ class PrefixCache:
         with self._lock:
             return (p, _key(ids, p)) in self._entries
 
-    def insert(self, ids: np.ndarray, p: int, kv: Any) -> None:
-        """Store prefix KV (a pytree of device arrays); LRU-evict past
-        the byte budget.  Evicted arrays stay alive for any in-flight
-        request that already fetched them (immutability)."""
+    @staticmethod
+    def _entry_bytes(kv: Any) -> int:
+        if hasattr(kv, "nbytes"):  # paged block-ref entries carry their own
+            return int(kv.nbytes)
         import jax
 
-        nbytes = sum(
+        return sum(
             int(np.prod(x.shape)) * x.dtype.itemsize
             for x in jax.tree.leaves(kv)
         )
+
+    def insert(self, ids: np.ndarray, p: int, kv: Any) -> None:
+        """Store prefix KV (a pytree of device arrays, or a paged
+        block-ref pin); LRU-evict past the byte budget.  Evicted
+        arrays stay alive for any in-flight request that already
+        fetched them (immutability); evicted paged entries drop the
+        cache's pool ref via ``on_evict`` (sharers keep theirs)."""
         key = (p, _key(ids, p))
         with self._lock:
             if key in self._entries:
                 return
             self._entries[key] = kv
-            self._bytes += nbytes
+            self._bytes += self._entry_bytes(kv)
             while self._bytes > self.budget_bytes and len(self._entries) > 1:
                 _, old = self._entries.popitem(last=False)
-                self._bytes -= sum(
-                    int(np.prod(x.shape)) * x.dtype.itemsize
-                    for x in jax.tree.leaves(old)
-                )
+                self._bytes -= self._entry_bytes(old)
+                if self.on_evict is not None:
+                    self.on_evict(old)
+
+    def pop_lru(self) -> Any | None:
+        """Evict the least-recently-used entry unconditionally (the
+        paged loop's reclaim path when the pool runs dry: pinned
+        prefix blocks are the first memory to give back).  Returns the
+        evicted entry or None when the cache is empty."""
+        with self._lock:
+            if not self._entries:
+                return None
+            _, old = self._entries.popitem(last=False)
+            self._bytes -= self._entry_bytes(old)
+            if self.on_evict is not None:
+                self.on_evict(old)
+            return old
 
     def stats(self) -> dict:
         with self._lock:

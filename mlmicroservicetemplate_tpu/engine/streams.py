@@ -95,7 +95,12 @@ class _Stream:
         "feats", "chunks", "loop", "cancelled", "produced", "released",
         "budget", "klass", "deadline", "started", "kv", "kv_held",
         "skip", "tokens", "preempted", "t_in", "_removed",
+        "blocks", "s_base", "s_lo", "shared_ids",
     )
+
+    # Admission-ledger marker: paged mode accounts streams via the
+    # block pool, batch calls via the byte ledger (admission.fits).
+    is_stream = True
 
     def __init__(self, feats: dict, loop: asyncio.AbstractEventLoop,
                  budget: int):
@@ -122,6 +127,14 @@ class _Stream:
         self.preempted = 0
         self.t_in = time.monotonic()
         self._removed = False
+        # Paged-KV bookkeeping (engine/kv_blocks.py): this stream's
+        # block table, the collated width its prefill scattered
+        # ([s_lo, s_base + chunk) — s_lo > 0 on CoW prefix hits), and
+        # any donor block ids it shares by refcount.
+        self.blocks = None
+        self.s_base = 0
+        self.s_lo = 0
+        self.shared_ids: list[int] = []
 
     def emit(self, item: Any) -> None:
         try:
@@ -187,6 +200,38 @@ class ContinuousDecodeLoop:
         # Slot count must divide over the replica mesh's batch axis.
         mult = engine.replicas.pad_multiple()
         self.n_slots = -(-self.max_streams // mult) * mult
+        # Block-paged KV (PAGED_KV=1): per-layer KV pools shared by all
+        # slots + a host-owned per-slot block table that rides into
+        # every dispatch as a traced argument.  Insert scatters a
+        # prefill state into freshly allocated blocks, decode grows
+        # block-by-block at chunk boundaries, frees return blocks the
+        # moment a stream ends, and prefix-cache hits ADOPT the
+        # donor's prompt blocks by refcount (CoW sharing).  A freed
+        # slot's table row is the SENTINEL id (== pool size): the dead
+        # row's further writes resolve out of range and drop, so a
+        # reallocated block can never be corrupted by its previous
+        # tenant (the paged mirror of the contiguous mode="drop"
+        # clamp).
+        self.paged = bool(getattr(engine, "paged_kv", False))
+        if self.paged:
+            from .kv_blocks import blocks_for
+
+            if self.spec:
+                raise ValueError(
+                    "PAGED_KV does not compose with SPEC_CONTINUOUS yet"
+                )
+            self.block_size = int(engine.kv_block_size)
+            self.pool = engine.kv_pool
+            self.nb_max = blocks_for(
+                self.max_prompt + engine.max_decode_len, self.block_size
+            )
+            self._table = np.full(
+                (self.n_slots, self.nb_max), self.pool.num_blocks, np.int32
+            )
+            self._paged_chunk = None
+            self._paged_insert = None
+            self._gather_prefix_fns: dict[int, Any] = {}
+            self._dispatched_steps: dict[int, int] = {}
         # SLA scheduling (scheduler/policy.py): the old unbounded
         # handoff Queue + instant reject past max_streams is now a
         # BOUNDED deadline-aware wait queue — up to ``max_stream_queue``
@@ -397,6 +442,10 @@ class ContinuousDecodeLoop:
         st = self.active.pop(slot, None)
         self.sampled_slots.discard(slot)
         self.free.append(slot)
+        # Paged: blocks return to the pool the moment the stream ends
+        # (early EOS, cancel, budget) — THE exact-ledger property; the
+        # contiguous layout holds its reservation until slot release.
+        self._release_blocks(slot, st)
         if st is not None:
             self._release(st)
 
@@ -591,6 +640,7 @@ class ContinuousDecodeLoop:
             self.active.pop(slot)
             self.sampled_slots.discard(slot)
             self.free.append(slot)
+            self._release_blocks(slot, st)
             if self.admission is not None:
                 self.admission.release(st)
             self._requeue_preempted(st)
@@ -645,6 +695,17 @@ class ContinuousDecodeLoop:
         else:
             st.skip = len(st.tokens)
         st.produced = 0
+        # A checkpointed stream holds NO ledger commitment while it
+        # waits (its reservation was released above by the caller);
+        # refresh the footprint it will re-reserve at dequeue — the
+        # recast path just FOLDED delivered tokens into the prompt, so
+        # the stale admission-time estimate can undershoot the new
+        # prompt bucket.
+        st.blocks = None
+        st.shared_ids = []
+        st.s_lo = st.s_base = 0
+        if self.admission is not None:
+            st.kv = self.admission.kv_bytes_for_resume(st.feats)
         self.queue.put(st, force=True)
 
     def _emit_tokens(self, st: _Stream, chunk) -> None:
@@ -696,7 +757,9 @@ class ContinuousDecodeLoop:
         if not ok:
             return started
         with eng._lock:
-            if eng.prefix_cache is not None and (len(ok) > 1 or self.spec):
+            if eng.prefix_cache is not None and (
+                len(ok) > 1 or self.spec or self.paged
+            ):
                 # Grouped wave admission under the per-request prefix
                 # cache: same-(prefix, suffix)-bucket hits batch into
                 # one prefixed start each, misses share one full
@@ -717,6 +780,14 @@ class ContinuousDecodeLoop:
                     except Exception as e:
                         self._finish(st, e)
                         continue
+                    if self.paged:
+                        from .engine import bucket_for
+
+                        st.s_lo = 0
+                        st.s_base = bucket_for(
+                            max(int(st.feats["length"]), 1),
+                            eng.seq_buckets, eng.replicas.seq_multiple(),
+                        )
                     self.prefill_dispatches += 1
                     prefetch_to_host(toks, state1.done)
                     started.append((st, state1, toks, sampled, 0, None, None))
@@ -752,6 +823,9 @@ class ContinuousDecodeLoop:
                 # wave must not pin 7 greedy streams' future chunks to
                 # the per-step [B, V] sort.
                 row_sampled = float(st.feats.get("temperature", 0.0)) > 0.0
+                if self.paged:
+                    st.s_lo = 0
+                    st.s_base = int(ids.shape[1])
                 started.append((st, state1, toks, row_sampled, row, ids, mask))
         return started
 
@@ -780,6 +854,18 @@ class ContinuousDecodeLoop:
                 misses.append((st, row_ids, L))
                 continue
             p_len, pkv = m
+            if self.paged:
+                # Paged hit: the entry is a block-ref pin, not KV.  The
+                # stream ADOPTS the donor's blocks (refcount, no copy)
+                # and the suffix prefill attends over a dense gather of
+                # them from the current pools.
+                from .kv_blocks import PagedPrefix
+
+                if self._state is None or not isinstance(pkv, PagedPrefix):
+                    misses.append((st, row_ids, L))
+                    continue
+                st.shared_ids = list(pkv.block_ids)
+                pkv = self._gather_prefix(p_len, pkv.block_ids)
             s_suf = bucket_for(
                 max(L - p_len, 1), eng.seq_buckets,
                 eng.replicas.seq_multiple(),
@@ -815,7 +901,11 @@ class ContinuousDecodeLoop:
         def donate(state1, row, row_ids, L, min_over: int | None):
             """Per-row prefix donation; ``min_over`` = only donate
             buckets strictly larger (the hit path's growing-
-            conversation rule), None = any (miss path)."""
+            conversation rule), None = any (miss path).  Paged mode
+            donates BLOCK REFS instead, which only exist after the
+            slot insert — ``_admit_complete`` handles it there."""
+            if self.paged:
+                return
             p_ins = eng.prefix_cache.bucket_for_insert(L)
             if (
                 p_ins is not None
@@ -840,6 +930,9 @@ class ContinuousDecodeLoop:
                     self._finish(st, e)
             else:
                 for row, (st, row_ids, L) in enumerate(misses):
+                    if self.paged:
+                        st.s_lo = 0
+                        st.s_base = int(ids.shape[1])
                     donate(state1, row, row_ids, L, None)
                 record(state1, toks, [st for st, _, _ in misses], ids, mask)
 
@@ -873,6 +966,9 @@ class ContinuousDecodeLoop:
                     self._finish(st, e)
                 continue
             for row, (st, row_ids, L, pl, _) in enumerate(members):
+                if self.paged:
+                    st.s_lo = pl
+                    st.s_base = pl + int(ids.shape[1])
                 # Growing conversations keep donating from the hit path
                 # (start_fused's rule, applied per row).
                 donate(state1, row, row_ids, L, pl)
@@ -911,22 +1007,42 @@ class ContinuousDecodeLoop:
             # Any failure from here (empty-state build OOM, insert
             # compile) must terminate THIS consumer and return the slot
             # — the _run handler only reaches streams in self.active.
+            from .kv_blocks import OutOfBlocks
+
             slot = None
             try:
                 if self._state is None:
                     self._build_empty_state()
                 slot = self.free.pop()
-                with eng._lock:
-                    if self.spec:
-                        self._state = self._insert_fn()(
-                            self._state, state1, ids, mask,
-                            self._hist_row(st.feats, toks_np[row]),
-                            np.int32(slot), np.int32(row),
-                        )
-                    else:
-                        self._state = self._insert_fn()(
-                            self._state, state1, np.int32(slot), np.int32(row)
-                        )
+                if self.paged:
+                    self._state = self._insert_paged_slot(
+                        st, state1, slot, row
+                    )
+                else:
+                    with eng._lock:
+                        if self.spec:
+                            self._state = self._insert_fn()(
+                                self._state, state1, ids, mask,
+                                self._hist_row(st.feats, toks_np[row]),
+                                np.int32(slot), np.int32(row),
+                            )
+                        else:
+                            self._state = self._insert_fn()(
+                                self._state, state1, np.int32(slot),
+                                np.int32(row)
+                            )
+            except OutOfBlocks:
+                # The fits() gate raced another reservation and the
+                # pool is momentarily dry: checkpoint the first chunk
+                # (already delivered) and re-queue — token-identical
+                # resume when blocks free up, never a dropped stream.
+                if slot is not None:
+                    self.free.append(slot)
+                metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+                if self.admission is not None:
+                    self.admission.release(st)
+                self._requeue_preempted(st)
+                continue
             except Exception as e:
                 if slot is not None:
                     self.free.append(slot)
@@ -935,6 +1051,8 @@ class ContinuousDecodeLoop:
             self.active[slot] = st
             if sampled:
                 self.sampled_slots.add(slot)
+            if self.paged and eng.prefix_cache is not None:
+                self._donate_paged(st, slot)
 
     def _build_empty_state(self) -> None:
         """All-slots-done decode state from a max-bucket prefill
@@ -959,6 +1077,9 @@ class ContinuousDecodeLoop:
                 template = jax.jit(eng.bundle.init_spec_fn)(
                     template, ids, mask
                 )
+        if self.paged:
+            self._build_empty_paged(template)
+            return
         empty = jax.tree.map(
             lambda x: np.zeros((self.n_slots,) + tuple(x.shape[1:]), x.dtype),
             template,
@@ -986,6 +1107,60 @@ class ContinuousDecodeLoop:
         # prefill-state) insert pair would then recompile on the first
         # real admission (measured ~1-8 s through the relay) because
         # warm() only ever saw NamedSharding-carrying states.
+        self._state = jax.device_put(empty, eng.replicas.batch_sharding)
+        jax.block_until_ready(jax.tree.leaves(self._state)[0])
+
+    def _build_empty_paged(self, template) -> None:
+        """All-slots-dead paged state: per-layer pools of
+        ``pool.num_blocks`` zeroed blocks (scale pools of ones under
+        QUANT_KV, mirroring the contiguous init) + per-row logical
+        fields at the slot count.  A rebuild (startup, warm reset,
+        post-exception recovery) also flushes the prefix cache's
+        block-ref pins — the pins name blocks of the POOL BUFFERS
+        being replaced, so their content is gone."""
+        import jax
+
+        from ..models.gpt import PagedState
+
+        eng = self.engine
+        if eng.prefix_cache is not None:
+            while eng.prefix_cache.pop_lru() is not None:
+                pass
+        bs = self.block_size
+        nbp = self.pool.num_blocks
+
+        def pool_leaf(x, fill):
+            arr = np.zeros((nbp, bs) + tuple(x.shape[2:]), x.dtype)
+            if fill:
+                arr[...] = 1
+            return arr
+
+        def pool_entry(c):
+            if isinstance(c, tuple):  # (int8 payload, scale)
+                return (pool_leaf(c[0], False), pool_leaf(c[1], True))
+            return pool_leaf(c, False)
+
+        empty = PagedState(
+            cache_k=[pool_entry(c) for c in template.cache_k],
+            cache_v=[pool_entry(c) for c in template.cache_v],
+            key_valid=np.zeros(
+                (self.n_slots, self.nb_max * bs), np.int32
+            ),
+            write_idx=np.zeros((self.n_slots,), np.int32),
+            pos=np.zeros((self.n_slots,), np.int32),
+            last_token=np.zeros((self.n_slots,), np.int32),
+            done=np.ones((self.n_slots,), bool),
+            tokens=np.zeros(
+                (self.n_slots,) + tuple(template.tokens.shape[1:]),
+                template.tokens.dtype,
+            ),
+            sample=jax.tree.map(
+                lambda x: np.zeros(
+                    (self.n_slots,) + tuple(x.shape[1:]), x.dtype
+                ),
+                template.sample,
+            ),
+        )
         self._state = jax.device_put(empty, eng.replicas.batch_sharding)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
 
@@ -1087,6 +1262,217 @@ class ContinuousDecodeLoop:
                 self._insert = jax.jit(insert)
         return self._insert
 
+    # -- paged executables ---------------------------------------------
+
+    def _paged_chunk_fn(self):
+        if self._paged_chunk is None:
+            import jax
+
+            self._paged_chunk = jax.jit(
+                self.engine.bundle.paged_chunk_fn, static_argnums=(3, 4)
+            )
+        return self._paged_chunk
+
+    def _paged_insert_fn(self):
+        """Paged slot insert: scatter rows [s_lo, s_cut) of one
+        prefill-state row into this slot's blocks (cache leaves route
+        through the table; CoW prefix rows [0, s_lo) are the donor's
+        blocks and are never rewritten), logical per-row fields land
+        via the same dynamic_update_slice as the contiguous insert.
+        One executable per static (s_lo, s_cut) pair — the (prefix
+        bucket, suffix bucket) grid, like the prefixed starts."""
+        if self._paged_insert is None:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            from ..models.gpt import PagedState
+            from ..ops.paged_attention import scatter_pages
+
+            bs = self.block_size
+
+            def ins_row(dst, src, slot, row):
+                src = lax.dynamic_slice_in_dim(src, row, 1, axis=0)
+                pad = [(0, 0)] + [
+                    (0, int(d) - int(s))
+                    for d, s in zip(dst.shape[1:], src.shape[1:])
+                ]
+                srcp = jnp.pad(src.astype(dst.dtype), pad)
+                start = (slot,) + (0,) * (dst.ndim - 1)
+                return lax.dynamic_update_slice(dst, srcp, start)
+
+            def insert(batched, single, table_row, slot, row,
+                       s_lo: int, s_cut: int):
+                def scat(pool, src):
+                    srow = lax.dynamic_slice_in_dim(src, row, 1, axis=0)[0]
+                    return scatter_pages(
+                        pool, table_row, srow[s_lo:s_cut], bs, start=s_lo
+                    )
+
+                def scat_entry(pc, sc):
+                    if isinstance(pc, tuple):
+                        return (scat(pc[0], sc[0]), scat(pc[1], sc[1]))
+                    return scat(pc, sc)
+
+                return PagedState(
+                    cache_k=[
+                        scat_entry(d, s)
+                        for d, s in zip(batched.cache_k, single.cache_k)
+                    ],
+                    cache_v=[
+                        scat_entry(d, s)
+                        for d, s in zip(batched.cache_v, single.cache_v)
+                    ],
+                    key_valid=ins_row(
+                        batched.key_valid, single.key_valid, slot, row
+                    ),
+                    write_idx=ins_row(
+                        batched.write_idx, single.write_idx, slot, row
+                    ),
+                    pos=ins_row(batched.pos, single.pos, slot, row),
+                    last_token=ins_row(
+                        batched.last_token, single.last_token, slot, row
+                    ),
+                    done=ins_row(batched.done, single.done, slot, row),
+                    tokens=ins_row(batched.tokens, single.tokens, slot, row),
+                    sample=jax.tree.map(
+                        lambda d, s: ins_row(d, s, slot, row),
+                        batched.sample, single.sample,
+                    ),
+                )
+
+            self._paged_insert = jax.jit(insert, static_argnums=(5, 6))
+        return self._paged_insert
+
+    def _gather_prefix(self, p_len: int, block_ids) -> Any:
+        """Dense ``{"k": [...], "v": [...]}`` view of a pinned prefix's
+        blocks, gathered from the CURRENT pools — what the prefixed
+        start executables consume on a paged cache hit.  Caller holds
+        ``eng._lock``; the blocks are write-once (streams never write
+        positions below their prefix), so any pool version at or past
+        the donor's insert reads the right rows."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.paged_attention import gather_pages
+
+        if p_len not in self._gather_prefix_fns:
+            bs = self.block_size
+
+            def gather(state, blocks):
+                def one(pool):
+                    return gather_pages(pool, blocks[None], bs)[:, :p_len]
+
+                def entry(c):
+                    if isinstance(c, tuple):
+                        return tuple(one(x) for x in c)
+                    return one(c)
+
+                return {
+                    "k": [entry(c) for c in state.cache_k],
+                    "v": [entry(c) for c in state.cache_v],
+                }
+
+            self._gather_prefix_fns[p_len] = jax.jit(gather)
+        blocks = jnp.asarray(np.asarray(block_ids, np.int32))
+        return self._gather_prefix_fns[p_len](self._state, blocks)
+
+    def _insert_paged_slot(self, st: _Stream, state1, slot: int, row: int):
+        """Allocate the stream's initial blocks (adopting CoW prefix
+        blocks first), point the slot's table row at them, and scatter
+        the prefill state in.  Raises ``OutOfBlocks`` (after trying to
+        reclaim prefix pins) with nothing leaked — the caller
+        re-queues the stream."""
+        import jax.numpy as jnp
+
+        from .kv_blocks import StreamBlocks
+
+        eng = self.engine
+        s_cut = st.s_base + eng.chunk_tokens
+        sb = StreamBlocks(self.pool, self.block_size)
+        try:
+            if st.shared_ids:
+                sb.adopt(st.shared_ids)
+            self._reclaim_then_ensure(sb, s_cut)
+            table_row = np.full(self.nb_max, self.pool.num_blocks, np.int32)
+            table_row[: len(sb.ids)] = sb.ids
+            with eng._lock:
+                new_state = self._paged_insert_fn()(
+                    self._state, state1, jnp.asarray(table_row),
+                    np.int32(slot), np.int32(row), st.s_lo, s_cut,
+                )
+        except BaseException:
+            sb.release()
+            raise
+        st.blocks = sb
+        self._table[slot] = table_row
+        self._dispatched_steps[slot] = eng.chunk_tokens
+        if self.admission is not None:
+            self.admission.note_pool()
+        return new_state
+
+    def _donate_paged(self, st: _Stream, slot: int) -> None:
+        """Paged prefix donation: pin the slot's prompt blocks by
+        refcount (``_capture_prefix``'s CoW counterpart — no KV copy;
+        eviction drops only the cache's ref, so sharers keep the
+        blocks alive).  Hit streams keep donating at growing buckets
+        (start_fused's rule); prefix buckets are block-aligned by the
+        build_model gate, so a pin never covers a partial block."""
+        from .kv_blocks import PagedPrefix
+
+        eng = self.engine
+        L = int(st.feats["length"])
+        row_ids = np.asarray(st.feats["input_ids"], np.int32)[:L]
+        p_ins = eng.prefix_cache.bucket_for_insert(L)
+        if (
+            p_ins is None
+            or (st.s_lo > 0 and p_ins <= st.s_lo)
+            or eng.prefix_cache.contains(row_ids, p_ins)
+            or st.blocks is None
+        ):
+            return
+        nb_pin = p_ins // self.block_size
+        if nb_pin <= 0 or nb_pin > len(st.blocks.ids):
+            return
+        ids = list(st.blocks.ids[:nb_pin])
+        self.pool.ref(ids)
+        eng.prefix_cache.insert(
+            row_ids, p_ins,
+            PagedPrefix(p_ins, tuple(ids), p_ins * eng.kv_token_bytes()),
+        )
+
+    def _release_blocks(self, slot: int, st: _Stream | None) -> None:
+        """Return a slot's blocks to the pool and point its table row
+        at the sentinel so in-state writes of the dead row drop."""
+        if not self.paged:
+            return
+        if st is not None and st.blocks is not None:
+            st.blocks.release()
+            st.blocks = None
+        self._table[slot, :] = self.pool.num_blocks
+        self._dispatched_steps.pop(slot, None)
+        if self.admission is not None:
+            self.admission.note_pool()
+
+    def _reclaim_then_ensure(self, sb, n_tokens: int) -> None:
+        """Grow ``sb`` to cover ``n_tokens``; when the pool runs dry,
+        evict LRU prefix pins (the cheapest memory to give back —
+        sharers keep their refs) until it fits or nothing is left to
+        evict (re-raises ``OutOfBlocks``)."""
+        from .kv_blocks import OutOfBlocks
+
+        eng = self.engine
+        while True:
+            try:
+                sb.ensure(n_tokens)
+                return
+            except OutOfBlocks:
+                if (
+                    eng.prefix_cache is None
+                    or eng.prefix_cache.pop_lru() is None
+                ):
+                    raise
+
     # -- decode --------------------------------------------------------
 
     def _work_remains(self) -> bool:
@@ -1098,8 +1484,73 @@ class ContinuousDecodeLoop:
             st.produced + ahead < st.budget for st in self.active.values()
         )
 
+    def _grow_for_dispatch(self) -> None:
+        """Block-by-block growth at the chunk boundary: every live
+        row's table must cover the positions the NEXT chunk will
+        write.  A row whose growth finds the pool dry — after
+        reclaiming prefix pins — is checkpointed and re-queued
+        (token-identical resume when blocks free), the paged
+        equivalent of vLLM's preempt-on-OOM; admission's worst-case
+        bound guarantees a stream running alone always fits, so this
+        terminates."""
+        from .kv_blocks import OutOfBlocks
+
+        eng = self.engine
+        chunk = eng.chunk_tokens
+        grew = False
+        for slot, st in list(self.active.items()):
+            if st.cancelled.is_set() or st.blocks is None:
+                continue  # frees at the next delivery; writes drop
+            steps = self._dispatched_steps.get(slot, 0) + chunk
+            # Writes past the budget are never read (the row frees at
+            # delivery); don't spend blocks on them.
+            need = min(st.s_base + steps, st.s_base + st.budget)
+            try:
+                fresh = st.blocks.ensure(need)
+            except OutOfBlocks:
+                try:
+                    self._reclaim_then_ensure(st.blocks, need)
+                    fresh = st.blocks.ids[-1:]  # table refresh below
+                except OutOfBlocks:
+                    metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+                    self.active.pop(slot)
+                    self.sampled_slots.discard(slot)
+                    self.free.append(slot)
+                    self._release_blocks(slot, st)
+                    if self.admission is not None:
+                        self.admission.release(st)
+                    self._requeue_preempted(st)
+                    continue
+            if fresh:
+                n = len(st.blocks.ids)
+                self._table[slot, :n] = st.blocks.ids
+                grew = True
+            self._dispatched_steps[slot] = steps
+        if grew and self.admission is not None:
+            self.admission.note_pool()
+
     def _dispatch_chunk(self) -> None:
         eng = self.engine
+        if self.paged:
+            self._grow_for_dispatch()
+            if not self.active:  # every row checkpointed on a dry pool
+                return
+            use_sample = bool(self.sampled_slots)
+            import jax.numpy as jnp
+
+            with eng._lock:
+                self._state, toks = self._paged_chunk_fn()(
+                    eng.params, self._state, jnp.asarray(self._table),
+                    eng.chunk_tokens, use_sample,
+                )
+                done = self._state.done
+                prefetch_to_host(toks, done)
+            self.chunk_dispatches += 1
+            metrics.STREAM_BATCH.labels(eng.bundle.name).observe(
+                len(self.active)
+            )
+            self._inflight_chunks.append((toks, done, dict(self.active)))
+            return
         use_sample = bool(self.sampled_slots)
         with eng._lock:
             if self.spec:
@@ -1192,6 +1643,9 @@ class ContinuousDecodeLoop:
         warm_sampled = _os.environ.get(
             "WARMUP_SAMPLING", "1"
         ).lower() not in ("0", "false", "no")
+        if self.paged:
+            self._warm_paged(warm_sampled)
+            return
 
         def do_insert(state1, ids, mask, s: int):
             if self.spec:
@@ -1361,6 +1815,96 @@ class ContinuousDecodeLoop:
             self._tune_chain_depth()
         # Reset to all-dead so warm inserts never leak into serving.
         self._build_empty_state()
+
+    def _warm_paged(self, warm_sampled: bool) -> None:
+        """Paged-mode warmup: the paged insert per (wave size × seq
+        bucket) and the paged chunk in both sample variants, against
+        temporarily-allocated blocks that are returned (and the state
+        reset) before serving.  The prefixed-hit insert variants
+        ((s_lo, s_cut) pairs) compile on first hit — paged deployments
+        restrict SEQ_BUCKETS anyway (the PREFIX_CACHE guidance), and a
+        one-off compile beats warming a grid most cells of which are
+        never served."""
+        import jax
+        import jax.numpy as jnp
+
+        from .kv_blocks import OutOfBlocks, StreamBlocks
+
+        eng = self.engine
+        wave_sizes = [1]
+        if self.n_slots > 1:
+            wave_sizes.append(self.n_slots)
+        for s in eng.seq_buckets:
+            for n_batch in wave_sizes:
+                feats_list = [
+                    {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
+                ] * n_batch
+                sb = StreamBlocks(self.pool, self.block_size)
+                try:
+                    sb.ensure(s + eng.chunk_tokens)
+                except OutOfBlocks:
+                    continue  # pool smaller than this bucket: unservable
+                table_row = np.full(
+                    self.nb_max, self.pool.num_blocks, np.int32
+                )
+                table_row[: len(sb.ids)] = sb.ids
+                try:
+                    with eng._lock:
+                        ids, mask, _ = eng._collate_text(feats_list)
+                        sp, _ = eng._collate_sample(feats_list, ids.shape[0])
+                        ids, mask = eng.replicas.place_batch(ids, mask)
+                        state1, _ = eng._start(
+                            eng.params, ids, mask, sp,
+                            eng.max_decode_len, eng.chunk_tokens, False,
+                        )
+                        self._state = self._paged_insert_fn()(
+                            self._state, state1, jnp.asarray(table_row),
+                            np.int32(0), np.int32(0), 0,
+                            s + eng.chunk_tokens,
+                        )
+                finally:
+                    sb.release()
+        for flag in (False, True) if warm_sampled else (False,):
+            with eng._lock:
+                self._state, toks = self._paged_chunk_fn()(
+                    eng.params, self._state, jnp.asarray(self._table),
+                    eng.chunk_tokens, flag,
+                )
+                jax.device_get(toks)
+        if self._auto_depth:
+            self._tune_chain_depth_paged()
+        self._build_empty_state()
+
+    def _tune_chain_depth_paged(self) -> None:
+        """Paged variant of ``_tune_chain_depth`` (the chunk takes the
+        table operand)."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        eng = self.engine
+        table = jnp.asarray(self._table)
+
+        def wall(k: int) -> float:
+            t0 = _time.perf_counter()
+            with eng._lock:
+                s = self._state
+                for _ in range(k):
+                    s, toks = self._paged_chunk_fn()(
+                        eng.params, s, table, eng.chunk_tokens, False
+                    )
+                jax.device_get(toks)
+            self._state = s
+            return _time.perf_counter() - t0
+
+        wall(1)
+        w1 = wall(1)
+        w5 = wall(5)
+        compute = max((w5 - w1) / 4.0, 1e-4)
+        rtt = max(w1 - compute, 0.0)
+        self.chain_depth = max(1, min(8, round(rtt / compute)))
+        self._admit_grace_s = min(self._admit_grace_s, rtt / 10.0)
 
     def _tune_chain_depth(self) -> None:
         """Pick the chunk-chain pipelining depth from measured numbers:

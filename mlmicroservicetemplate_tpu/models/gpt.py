@@ -377,3 +377,174 @@ def greedy_generate(
     state = init_decode_state(params, cfg, input_ids, attention_mask, max_len, dtype)
     state, _ = generate_chunk(params, cfg, state, max_len)
     return state.tokens
+
+
+# ---------------------------------------------------------------------------
+# block-paged decode (PAGED_KV=1; engine/kv_blocks.py owns the tables)
+
+
+class PagedState(NamedTuple):
+    """Decode state over a block-paged KV pool (``PAGED_KV=1``).
+
+    Identical to ``GPTState`` except the caches: instead of per-row
+    contiguous ``[B, W, H, D]`` slabs, K/V live in pools of
+    ``block_size``-token blocks ``[NB, BS, H, D]`` shared by every
+    row, and logical position ``p`` of row ``b`` resolves through a
+    host-owned block table (``table[b, p // BS]``) that rides into
+    each dispatch as a traced argument — NOT part of this state, so
+    the host can grow/free blocks between dispatches without touching
+    device buffers.  All non-cache fields keep their per-row GPTState
+    semantics, which is what keeps paged decode token-identical to the
+    contiguous layout: positions, masks and sampling never change,
+    only where a KV row physically lives."""
+
+    cache_k: Any  # per layer [NB, BS, H, D] pool ((int8, scale) under QUANT_KV)
+    cache_v: Any
+    key_valid: jax.Array  # [B, W] int32 over LOGICAL positions (W = T*BS)
+    write_idx: jax.Array  # [B]
+    pos: jax.Array  # [B]
+    last_token: jax.Array  # [B]
+    done: jax.Array  # [B]
+    tokens: jax.Array  # [B, Tmax]
+    sample: Any
+
+
+def _paged_dest(table: jax.Array, t: jax.Array, bs: int, nb: int) -> jax.Array:
+    """Flat pool index of logical position ``t`` per row; out-of-table
+    positions (long-dead rows) and sentinel table entries both resolve
+    out of range so ``.at[].set(mode="drop")`` drops them."""
+    bidx = t // bs
+    blk = jnp.take_along_axis(
+        table, jnp.minimum(bidx, table.shape[1] - 1)[:, None], axis=1
+    )[:, 0]
+    blk = jnp.where(bidx < table.shape[1], blk, nb)
+    return blk * bs + t % bs
+
+
+def paged_write_token(pool, table, t, val, bs: int):
+    """Scatter one new K (or V) row per batch row into a dense pool."""
+    nb = pool.shape[0]
+    flat = pool.reshape((nb * bs,) + pool.shape[2:])
+    dest = _paged_dest(table, t, bs, nb)
+    flat = flat.at[dest].set(val.astype(pool.dtype), mode="drop")
+    return flat.reshape(pool.shape)
+
+
+def _paged_decode_step(
+    params: Params, cfg: GPTConfig, state: PagedState, table: jax.Array,
+    sample: bool = False,
+):
+    """One decode step reading/writing K/V through the block table;
+    everything else is ``_decode_step`` verbatim — same positions,
+    same mask semantics, same logits — so greedy outputs are
+    token-identical to the contiguous path."""
+    from ..ops.paged_attention import gather_pages
+
+    dtype = state.cache_k[0].dtype
+    bs = state.cache_k[0].shape[1]
+    b = state.last_token.shape[0]
+    rows = jnp.arange(b)
+    t = state.write_idx
+    x = embed(params["wte"], state.last_token[:, None], dtype)
+    x = x + embed(params["wpe"], jnp.minimum(t, cfg.max_position - 1), dtype)[:, None]
+    key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
+    attn_mask = (key_valid != 0)[:, None, None, :]
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h)
+        ck = paged_write_token(state.cache_k[li], table, t, k1[:, 0], bs)
+        cv = paged_write_token(state.cache_v[li], table, t, v1[:, 0], bs)
+        new_k.append(ck)
+        new_v.append(cv)
+        kd = gather_pages(ck, table, bs)
+        vd = gather_pages(cv, table, bs)
+        ctx = mha_attention(q, kd, vd, mask=attn_mask)
+        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
+        x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
+    x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
+    logits = _logits(params, cfg, x[:, 0])
+
+    if sample:
+        from .sampling import select_token
+
+        next_tok, sp = select_token(logits, state.sample)
+    else:
+        next_tok, sp = jnp.argmax(logits, axis=-1).astype(jnp.int32), state.sample
+    next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
+    done = state.done | (next_tok == cfg.eos_id)
+    tokens = state.tokens.at[rows, state.pos].set(next_tok, mode="drop")
+    return (
+        PagedState(
+            cache_k=new_k, cache_v=new_v, key_valid=key_valid,
+            write_idx=t + 1, pos=state.pos + 1, last_token=next_tok,
+            done=done, tokens=tokens, sample=sp,
+        ),
+        next_tok,
+    )
+
+
+def generate_chunk_paged(
+    params: Params, cfg: GPTConfig, state: PagedState, table: jax.Array,
+    n_steps: int, sample: bool = False,
+) -> tuple[PagedState, jax.Array]:
+    """``n_steps`` paged decode steps in one compiled scan (the
+    engine's chunk contract, plus the traced block table)."""
+
+    def step(s, _):
+        return _paged_decode_step(params, cfg, s, table, sample)
+
+    state, toks = jax.lax.scan(step, state, None, length=n_steps)
+    return state, jnp.transpose(toks)
+
+
+def init_paged_state(
+    params: Params,
+    cfg: GPTConfig,
+    input_ids: jax.Array,  # [B, S] right-padded
+    attention_mask: jax.Array,
+    max_len: int,
+    table: jax.Array,  # [B, T] block ids covering S (+ growth later)
+    num_blocks: int,
+    block_size: int,
+    dtype=jnp.float32,
+    sample=None,
+) -> PagedState:
+    """Prefill straight into pool blocks: the prompt forward's K/V
+    scatter through the table instead of filling a contiguous slab."""
+    from ..ops.paged_attention import scatter_pages
+    from .sampling import greedy_params
+
+    b, s = input_ids.shape
+    t_w = table.shape[1]
+    _, kv = forward_hidden(
+        params, cfg, input_ids, attention_mask, dtype, collect_kv=True
+    )
+    cache_k, cache_v = [], []
+    for k, v in kv:
+        shape = (num_blocks, block_size, cfg.num_heads, cfg.head_dim)
+        ck = jnp.zeros(shape, k.dtype)
+        cv = jnp.zeros(shape, v.dtype)
+        for row in range(b):
+            ck = scatter_pages(ck, table[row], k[row], block_size)
+            cv = scatter_pages(cv, table[row], v[row], block_size)
+        cache_k.append(ck)
+        cache_v.append(cv)
+    lengths = attention_mask.sum(axis=-1).astype(jnp.int32)
+    key_valid = jnp.zeros((b, t_w * block_size), jnp.int32)
+    key_valid = key_valid.at[:, :s].set(attention_mask.astype(jnp.int32))
+    rows = jnp.arange(b)
+    last_tok = input_ids[rows, jnp.maximum(lengths - 1, 0)]
+    return PagedState(
+        cache_k=cache_k,
+        cache_v=cache_v,
+        key_valid=key_valid,
+        write_idx=jnp.maximum(lengths - 1, 0),
+        pos=jnp.zeros((b,), jnp.int32),
+        last_token=last_tok.astype(jnp.int32),
+        done=lengths == 0,
+        tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+        sample=sample if sample is not None else greedy_params(b),
+    )

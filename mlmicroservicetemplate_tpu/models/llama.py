@@ -544,3 +544,197 @@ def greedy_generate(
     state = init_decode_state(params, cfg, input_ids, attention_mask, max_len, dtype)
     state, _ = generate_chunk(params, cfg, state, max_len)
     return state.tokens
+
+
+# ---------------------------------------------------------------------------
+# block-paged decode (PAGED_KV=1) — gpt.PagedState layout at GQA width,
+# composed with the int8 KV cache ((payload, scale) pool pairs).
+
+
+def _paged_write_kv(cache, table, t, val, bs: int, dtype):
+    """Scatter one new K (or V) row per batch row through the block
+    table, into a dense pool or an (int8 payload, scale) pool pair —
+    the paged mirror of ``_write_kv`` (same quantization, so paged
+    int8 decode stays bit-identical to the contiguous int8 cache)."""
+    from .gpt import paged_write_token
+
+    if isinstance(cache, tuple):
+        q8, sc = kv_quantize(val)
+        return (
+            paged_write_token(cache[0], table, t, q8, bs),
+            paged_write_token(cache[1], table, t, sc.astype(dtype), bs),
+        )
+    return paged_write_token(cache, table, t, val, bs)
+
+
+def _paged_cache_attention(cfg: LlamaConfig, q, ck, cv, table, key_valid,
+                           bs: int):
+    """Attention over the paged pool.  With ``cfg.pallas_decode`` the
+    single-query step runs the fused paged kernel — each program DMAs
+    exactly the row's live blocks, int8 payloads dequantize in VMEM.
+    Otherwise the row's blocks gather to a dense view and run the
+    contiguous path's exact math (token identity by construction)."""
+    if cfg.pallas_decode and q.shape[1] == 1:
+        from ..ops.paged_attention import paged_decode_attention
+
+        if isinstance(ck, tuple):
+            ctx = paged_decode_attention(
+                q[:, 0], ck[0], cv[0], table, key_valid, bs,
+                k_scale=ck[1], v_scale=cv[1],
+            )
+        else:
+            ctx = paged_decode_attention(q[:, 0], ck, cv, table, key_valid, bs)
+        return ctx[:, None]
+    from ..ops.paged_attention import gather_pages
+
+    mask = (key_valid != 0)[:, None, None, :]
+    if isinstance(ck, tuple):
+        return mha_attention_kv8(
+            q,
+            _repeat_kv(gather_pages(ck[0], table, bs), cfg.n_rep),
+            _repeat_kv(gather_pages(ck[1], table, bs), cfg.n_rep),
+            _repeat_kv(gather_pages(cv[0], table, bs), cfg.n_rep),
+            _repeat_kv(gather_pages(cv[1], table, bs), cfg.n_rep),
+            mask=mask,
+        )
+    return mha_attention(
+        q,
+        _repeat_kv(gather_pages(ck, table, bs), cfg.n_rep),
+        _repeat_kv(gather_pages(cv, table, bs), cfg.n_rep),
+        mask=mask,
+    )
+
+
+def _paged_decode_step(params: Params, cfg: LlamaConfig, state, table,
+                       sample: bool = False):
+    """One paged decode step: ``_decode_step`` with cache reads/writes
+    resolved through the block table (RoPE, GQA, sampling and EOS
+    logic unchanged — physical layout is the only difference)."""
+    from .gpt import PagedState
+
+    entry = state.cache_k[0]
+    dtype = entry[1].dtype if isinstance(entry, tuple) else entry.dtype
+    bs = entry[0].shape[1] if isinstance(entry, tuple) else entry.shape[1]
+    b = state.last_token.shape[0]
+    rows = jnp.arange(b)
+    t = state.write_idx
+    x = embed(params["embed"], state.last_token[:, None], dtype)
+    cos, sin = _rope_tables(cfg, jnp.minimum(t, cfg.max_position - 1), dtype)
+    cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+    key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
+        a = layer["attn"]
+        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        ck = _paged_write_kv(state.cache_k[li], table, t, k1[:, 0], bs, dtype)
+        cv = _paged_write_kv(state.cache_v[li], table, t, v1[:, 0], bs, dtype)
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = _paged_cache_attention(cfg, q, ck, cv, table, key_valid, bs)
+        x = x + dense(a["o"], merge_heads(ctx))
+        h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
+        m = layer["mlp"]
+        x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
+    x = rmsnorm(params["final_ln"], x, eps=cfg.rms_eps)
+    logits = lm_head_logits(x[:, 0], params["lm_head"]["kernel"], transposed=False)
+
+    if sample:
+        from .sampling import select_token
+
+        next_tok, sp = select_token(logits, state.sample)
+    else:
+        next_tok, sp = jnp.argmax(logits, axis=-1).astype(jnp.int32), state.sample
+    next_tok = jnp.where(state.done, jnp.int32(cfg.pad_id), next_tok)
+    done = state.done | (next_tok == cfg.eos_id)
+    tokens = state.tokens.at[rows, state.pos].set(next_tok, mode="drop")
+    return (
+        PagedState(
+            cache_k=new_k, cache_v=new_v, key_valid=key_valid,
+            write_idx=t + 1, pos=state.pos + 1, last_token=next_tok,
+            done=done, tokens=tokens, sample=sp,
+        ),
+        next_tok,
+    )
+
+
+def generate_chunk_paged(params: Params, cfg: LlamaConfig, state, table,
+                         n_steps: int, sample: bool = False):
+    """``n_steps`` paged decode steps in one compiled scan."""
+
+    def step(s, _):
+        return _paged_decode_step(params, cfg, s, table, sample)
+
+    state, toks = jax.lax.scan(step, state, None, length=n_steps)
+    return state, jnp.transpose(toks)
+
+
+def init_paged_state(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    max_len: int,
+    table: jax.Array,  # [B, T] block ids covering the prompt width
+    num_blocks: int,
+    block_size: int,
+    dtype=jnp.float32,
+    sample=None,
+):
+    """Prefill straight into pool blocks (int8 pools under kv_quant,
+    same per-token scales as the contiguous cache).  Paged mode has no
+    global ``__prefix__`` overlay (build_model rejects the combo) —
+    per-request prefixes share BLOCKS instead."""
+    from ..ops.paged_attention import scatter_pages
+    from .gpt import PagedState
+    from .sampling import greedy_params
+
+    b, s = input_ids.shape
+    t_w = table.shape[1]
+    _, kv = forward_hidden(
+        params, cfg, input_ids, attention_mask, dtype, collect_kv=True
+    )
+    cache_k, cache_v = [], []
+    shape = (num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    for k, v in kv:
+        if cfg.kv_quant:
+            k8, ks = kv_quantize(k)
+            v8, vs = kv_quantize(v)
+            ck8 = jnp.zeros(shape, jnp.int8)
+            cks = jnp.ones(shape[:3] + (1,), dtype)
+            cv8 = jnp.zeros(shape, jnp.int8)
+            cvs = jnp.ones(shape[:3] + (1,), dtype)
+            for row in range(b):
+                ck8 = scatter_pages(ck8, table[row], k8[row], block_size)
+                cks = scatter_pages(cks, table[row], ks[row].astype(dtype), block_size)
+                cv8 = scatter_pages(cv8, table[row], v8[row], block_size)
+                cvs = scatter_pages(cvs, table[row], vs[row].astype(dtype), block_size)
+            cache_k.append((ck8, cks))
+            cache_v.append((cv8, cvs))
+            continue
+        ck = jnp.zeros(shape, k.dtype)
+        cv = jnp.zeros(shape, v.dtype)
+        for row in range(b):
+            ck = scatter_pages(ck, table[row], k[row], block_size)
+            cv = scatter_pages(cv, table[row], v[row], block_size)
+        cache_k.append(ck)
+        cache_v.append(cv)
+    lengths = attention_mask.sum(axis=-1).astype(jnp.int32)
+    key_valid = jnp.zeros((b, t_w * block_size), jnp.int32)
+    key_valid = key_valid.at[:, :s].set(attention_mask.astype(jnp.int32))
+    rows = jnp.arange(b)
+    last_tok = input_ids[rows, jnp.maximum(lengths - 1, 0)]
+    return PagedState(
+        cache_k=cache_k,
+        cache_v=cache_v,
+        key_valid=key_valid,
+        write_idx=jnp.maximum(lengths - 1, 0),
+        pos=jnp.zeros((b,), jnp.int32),
+        last_token=last_tok.astype(jnp.int32),
+        done=lengths == 0,
+        tokens=jnp.full((b, max_len), cfg.pad_id, jnp.int32),
+        sample=sample if sample is not None else greedy_params(b),
+    )

@@ -74,6 +74,13 @@ class ModelBundle:
     # temperature>0 rows.  None = family does not support SPEC_DECODE.
     init_spec_fn: Callable | None = None
     spec_chunk_fn: Callable | None = None
+    # Block-paged KV decode (PAGED_KV=1, decoder-only families):
+    # paged_chunk_fn(params, paged_state, table, n_steps, sample=False)
+    # -> (paged_state, tokens) runs n_steps decode steps reading and
+    # writing K/V through the traced block table (models/gpt.PagedState
+    # layout; engine/kv_blocks.py owns the host-side tables).  None =
+    # family does not support PAGED_KV.
+    paged_chunk_fn: Callable | None = None
 
     # -- host-side single-item pre/post ------------------------------------
     def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
@@ -565,6 +572,9 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return gpt_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
+    def paged_chunk_fn(p, state, table, n_steps: int, sample: bool = False):
+        return gpt_mod.generate_chunk_paged(p, cfg, state, table, n_steps, sample)
+
     from . import spec as spec_mod
 
     init_spec_fn = spec_mod.make_init_spec_fn(p_len)
@@ -595,6 +605,7 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         supports_prefix=True,
         init_spec_fn=init_spec_fn,
         spec_chunk_fn=spec_chunk_fn,
+        paged_chunk_fn=paged_chunk_fn,
     )
 
 
@@ -748,6 +759,11 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def generate_chunk_fn(p, state, n_steps: int, sample: bool = False):
         return llama_mod.generate_chunk(p, cfg, state, n_steps, sample)
 
+    def paged_chunk_fn(p, state, table, n_steps: int, sample: bool = False):
+        return llama_mod.generate_chunk_paged(
+            p, cfg, state, table, n_steps, sample
+        )
+
     from . import spec as spec_mod
 
     init_spec_fn = spec_mod.make_init_spec_fn(p_len)
@@ -777,6 +793,7 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         supports_prefix=True,
         init_spec_fn=init_spec_fn,
         spec_chunk_fn=spec_chunk_fn,
+        paged_chunk_fn=paged_chunk_fn,
     )
 
 
@@ -869,6 +886,42 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
             raise ValueError(
                 "SPEC_CONTINUOUS requires SPEC_DECODE=ngram (it is the "
                 "continuous-loop extension of speculative decoding)"
+            )
+    if getattr(svc_cfg, "paged_kv", False):
+        # PAGED_KV v1 scope (docs/kv-paging.md): block-paged decode in
+        # the continuous loop, decoder-only families.  Every unsupported
+        # combination rejects loudly — a silently-contiguous deployment
+        # would report paged occupancy wins it isn't getting.
+        if bundle.paged_chunk_fn is None:
+            raise ValueError(
+                f"PAGED_KV is not supported for {svc_cfg.model_name!r} "
+                "(block-paged KV covers the decoder families: gpt2, llama)"
+            )
+        if getattr(svc_cfg, "prompt_prefix", None):
+            raise ValueError(
+                "PAGED_KV and PROMPT_PREFIX are mutually exclusive: the "
+                "global prefix overlay predates the block pool — use "
+                "PREFIX_CACHE=1, whose hits SHARE prompt blocks by "
+                "refcount"
+            )
+        if getattr(svc_cfg, "spec_continuous", False):
+            raise ValueError(
+                "PAGED_KV does not yet compose with SPEC_CONTINUOUS "
+                "(speculative verify windows write multi-token spans "
+                "through the table; planned follow-up)"
+            )
+        bs = int(getattr(svc_cfg, "kv_block_size", 16))
+        bad = [b for b in svc_cfg.seq_buckets if b % bs]
+        if bad:
+            raise ValueError(
+                f"KV_BLOCK_SIZE={bs} must divide every seq bucket "
+                f"(prefix sharing needs block-aligned buckets); "
+                f"offending buckets: {bad}"
+            )
+        if int(getattr(svc_cfg, "replicas", 0) or 0) > 1:
+            raise ValueError(
+                "PAGED_KV requires REPLICAS=1: the block pool has no "
+                "batch axis to shard over the replica mesh"
             )
     if getattr(svc_cfg, "prefix_cache", False):
         if not bundle.supports_prefix:

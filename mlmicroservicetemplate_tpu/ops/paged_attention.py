@@ -1,0 +1,233 @@
+"""Paged-attention decode: fused attention over a block-paged KV pool.
+
+Paged mode (``PAGED_KV=1``) stores the KV cache as a pool of
+fixed-size token blocks ``[NB, BS, KVH, D]`` shared by every live
+stream, with a per-row block table mapping logical position
+``p -> pool[table[row, p // BS], p % BS]``.  This module is the
+device-side half:
+
+- ``gather_pages``: XLA fallback — materialize a row's dense
+  ``[B, W, KVH, D]`` view through the table (one ``take``; XLA fuses
+  it into the consumer).  The models' paged decode steps attend over
+  this view with their EXISTING attention code, which is what makes
+  paged decode token-identical to the contiguous layout by
+  construction.
+- ``paged_decode_attention``: Pallas kernel — grid ``(B, NB)`` with
+  the block table as a scalar-prefetch operand, so each program DMAs
+  exactly one of its row's blocks HBM->VMEM (the gather never
+  materializes in HBM) and folds it into an online-softmax
+  accumulator, FlashAttention-style.  Composes with ``QUANT_KV=int8``:
+  payloads cross at int8 width with per-token-head f32 scales riding
+  in their own paged pool, dequantized in VMEM like
+  ``ops/attention.decode_attention``.  ``interpret=True`` runs the
+  same kernel on CPU (the test/fallback path, same pattern as
+  ``parallel/ring.py``).
+
+Sentinel table entries (freed slots) must be clamped to a real block
+id by the caller — out-of-range ids would index past the pool — and
+masked via ``key_valid``; ``gather_pages`` clamps internally.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_pages(pool: jax.Array, table: jax.Array, block_size: int) -> jax.Array:
+    """Dense view of each row's blocks: ``[NB, BS, ...] x [B, T]`` ->
+    ``[B, T*BS, ...]``.  Out-of-range table ids (the freed-slot
+    sentinel) clamp to the last block; callers mask those positions
+    with ``key_valid``, and clamped garbage is finite (pools are
+    zero-initialized), so a masked softmax stays well-behaved."""
+    nb = pool.shape[0]
+    flat = pool.reshape((nb * block_size,) + pool.shape[2:])
+    idx = (
+        jnp.clip(table, 0, nb - 1)[:, :, None] * block_size
+        + jnp.arange(block_size)[None, None, :]
+    )  # [B, T, BS]
+    b, t, _ = idx.shape
+    return jnp.take(flat, idx.reshape(b, t * block_size), axis=0)
+
+
+def scatter_pages(
+    pool: jax.Array, table_row: jax.Array, values: jax.Array,
+    block_size: int, start: int = 0,
+) -> jax.Array:
+    """Write ``values`` ``[W, ...]`` at logical positions
+    ``start..start+W-1`` of ONE row's blocks.  Positions whose table
+    entry is out of range (sentinel) drop — the paged insert relies on
+    this for pad regions and freed slots."""
+    nb = pool.shape[0]
+    w = values.shape[0]
+    flat = pool.reshape((nb * block_size,) + pool.shape[2:])
+    p = start + jnp.arange(w)
+    blk = jnp.take(table_row, p // block_size, mode="fill", fill_value=nb)
+    dest = blk * block_size + p % block_size  # OOB where sentinel
+    flat = flat.at[dest].set(values.astype(pool.dtype), mode="drop")
+    return flat.reshape(pool.shape)
+
+
+def _paged_body(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, valid_ref,
+                o_ref, m_scr, l_scr, a_scr, *, scale: float, kvh: int):
+    """One (row, block) grid step: fold block j of row b into the
+    row's online-softmax accumulators; finalize on the last block.
+    Blocks: q/o [1, KVH, R, D]; k/v [1, BS, KVH, D] (int8 payloads
+    with ks/vs [1, BS, KVH] scales on the quantized path); valid
+    [1, 1, BS].  Scratch (f32, VMEM): m/l [KVH, R], acc [KVH, R, D] —
+    persistent across the sequential block axis, reset at j == 0."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        a_scr[...] = jnp.zeros_like(a_scr)
+
+    valid = valid_ref[0, 0]  # [BS]
+    ks_all = None if ks_ref is None else ks_ref[0].astype(jnp.float32)
+    vs_all = None if vs_ref is None else vs_ref[0].astype(jnp.float32)
+    for g in range(kvh):
+        q = q_ref[0, g].astype(jnp.float32)  # [R, D]
+        k = k_ref[0, :, g].astype(jnp.float32)  # [BS, D]
+        if ks_all is not None:
+            k = k * ks_all[:, g:g + 1]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [R, BS]
+        s = jnp.where(valid[None, :] != 0, s, jnp.float32(-1e30))
+        m_prev = m_scr[g]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[g] = l_scr[g] * corr + p.sum(axis=-1)
+        v = v_ref[0, :, g].astype(jnp.float32)
+        if vs_all is not None:
+            v = v * vs_all[:, g:g + 1]
+        a_scr[g] = a_scr[g] * corr[:, None] + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[g] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        o_ref[0] = (
+            a_scr[...] / jnp.maximum(l_scr[...], 1e-20)[..., None]
+        ).astype(o_ref.dtype)
+
+
+def _paged_kernel(tbl_ref, q_ref, k_ref, v_ref, valid_ref, o_ref,
+                  m_scr, l_scr, a_scr, *, scale: float, kvh: int):
+    _paged_body(tbl_ref, q_ref, k_ref, None, v_ref, None, valid_ref,
+                o_ref, m_scr, l_scr, a_scr, scale=scale, kvh=kvh)
+
+
+def _paged_kernel_kv8(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                      valid_ref, o_ref, m_scr, l_scr, a_scr, *,
+                      scale: float, kvh: int):
+    _paged_body(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, valid_ref,
+                o_ref, m_scr, l_scr, a_scr, scale=scale, kvh=kvh)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "scale", "interpret"))
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, D] — one query per row
+    k_pool: jax.Array,  # [NB, BS, KVH, D] dense, or int8 payload
+    v_pool: jax.Array,
+    table: jax.Array,  # [B, T] block ids (caller clamps sentinels)
+    key_valid: jax.Array,  # [B, T*BS] 1 = attend
+    block_size: int,
+    k_scale: jax.Array | None = None,  # [NB, BS, KVH, 1] -> int8 path
+    v_scale: jax.Array | None = None,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused paged decode attention; returns ``[B, H, D]``.
+
+    Grid (B, T): program (b, j) DMAs block ``table[b, j]`` of the pool
+    into VMEM via the scalar-prefetched table — HBM traffic is exactly
+    the row's live blocks, never a materialized dense gather — and
+    accumulates FlashAttention-style (the block axis is sequential on
+    TPU, so the VMEM scratch carries m/l/acc across it).  VMEM per
+    program is one [BS, KVH, D] K+V block pair + [KVH, R, D] f32
+    accumulators: ~50 KB at BS=16, KVH=4, D=64 — tiny, so pool size
+    never hits a VMEM wall (the whole-slab decode kernel's limit)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    nb_pool, bs, kvh, _ = k_pool.shape
+    t = table.shape[1]
+    n_rep = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, kvh, n_rep, d)
+    tbl = jnp.clip(table, 0, nb_pool - 1).astype(jnp.int32)
+    validb = key_valid.astype(jnp.int32).reshape(b, t, bs)
+
+    q_spec = pl.BlockSpec((1, kvh, n_rep, d), lambda i, j, tb: (i, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, bs, kvh, d), lambda i, j, tb: (tb[i, j], 0, 0, 0))
+    valid_spec = pl.BlockSpec((1, 1, bs), lambda i, j, tb: (i, j, 0))
+    scratch = [
+        pltpu.VMEM((kvh, n_rep), jnp.float32),
+        pltpu.VMEM((kvh, n_rep), jnp.float32),
+        pltpu.VMEM((kvh, n_rep, d), jnp.float32),
+    ]
+    if k_scale is None:
+        kernel = functools.partial(_paged_kernel, scale=scale, kvh=kvh)
+        in_specs = [q_spec, kv_spec, kv_spec, valid_spec]
+        args = (tbl, qg, k_pool, v_pool, validb)
+    else:
+        sc_spec = pl.BlockSpec((1, bs, kvh), lambda i, j, tb: (tb[i, j], 0, 0))
+        kernel = functools.partial(_paged_kernel_kv8, scale=scale, kvh=kvh)
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, valid_spec]
+        args = (tbl, qg, k_pool, k_scale[..., 0], v_pool, v_scale[..., 0], validb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, t),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, n_rep, d), q.dtype),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, d)
+
+
+def paged_attention_ref(
+    q: jax.Array, k_pool: jax.Array, v_pool: jax.Array, table: jax.Array,
+    key_valid: jax.Array, block_size: int,
+    k_scale: jax.Array | None = None, v_scale: jax.Array | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """jnp reference for the kernel: gather the dense view, dequantize,
+    and run masked softmax attention in f32.  Also the XLA serving
+    fallback shape the models reproduce inline."""
+    b, h, d = q.shape
+    kvh = k_pool.shape[2]
+    n_rep = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    kd = gather_pages(k_pool, table, block_size).astype(jnp.float32)
+    vd = gather_pages(v_pool, table, block_size).astype(jnp.float32)
+    if k_scale is not None:
+        kd = kd * gather_pages(k_scale, table, block_size).astype(jnp.float32)
+        vd = vd * gather_pages(v_scale, table, block_size).astype(jnp.float32)
+    qg = q.reshape(b, kvh, n_rep, d).astype(jnp.float32)
+    s = jnp.einsum("bgrd,btgd->bgrt", qg, kd) * scale
+    s = jnp.where(key_valid[:, None, None, :] != 0, s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrt,btgd->bgrd", p, vd)
+    return o.reshape(b, h, d).astype(q.dtype)

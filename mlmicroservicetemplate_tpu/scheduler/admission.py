@@ -54,6 +54,30 @@ class AdmissionController:
         self._committed = 0
         self._lock = threading.Lock()
         self.draining = False
+        # Paged mode (PAGED_KV=1): streams are accounted by the
+        # engine's block pool — the EXACT ledger (allocated blocks ×
+        # block bytes, growth and frees included) — instead of this
+        # controller's ceiling ledger.  The byte ledger stays in place
+        # for the non-stream batch path, gated against whatever the
+        # pool hasn't claimed.
+        self.paged = bool(getattr(engine, "paged_kv", False))
+        self.pool = getattr(engine, "kv_pool", None)
+
+    def _pool_bytes(self) -> int:
+        return self.pool.used_bytes if (self.paged and self.pool) else 0
+
+    def note_pool(self) -> None:
+        """Refresh the committed-bytes gauge off the pool (paged)."""
+        if self.paged and self.pool:
+            metrics.KV_COMMITTED.labels(self.model).set(
+                self._committed + self.pool.used_bytes
+            )
+            metrics.KV_POOL_BLOCKS.labels(self.model, "used").set(
+                self.pool.used_blocks
+            )
+            metrics.KV_POOL_BLOCKS.labels(self.model, "free").set(
+                self.pool.free_blocks
+            )
 
     # -- classification ------------------------------------------------
 
@@ -75,14 +99,43 @@ class AdmissionController:
         est = getattr(self.engine, "kv_bytes_estimate", None)
         return int(est(feats)) if est is not None else 0
 
+    def kv_bytes_for_resume(self, feats: dict) -> int:
+        """Footprint a checkpointed stream re-reserves at dequeue, off
+        its CURRENT feats — the recast resume folds delivered tokens
+        into the prompt, so the admission-time estimate can undershoot
+        the new prompt bucket."""
+        if self.paged and self.pool is not None:
+            initial, _ = self.engine.kv_blocks_estimate(feats)
+            return initial * self.pool.block_bytes
+        return self.kv_bytes(feats)
+
     def admit(self, feats: dict, klass: str) -> tuple[str, int]:
         """Drain + KV-budget gate.  Returns (possibly down-classed
         klass, kv bytes); raises ``QueueFullError`` with reason
-        ``drain`` or ``kv_budget``."""
+        ``drain`` or ``kv_budget``.
+
+        Paged mode swaps the ceiling math for the block ledger: a
+        stream that could NEVER fit (its prompt bucket + its own
+        decode budget in blocks exceeds the whole pool) sheds here;
+        the returned kv is only the INITIAL commitment — prompt blocks
+        plus the first chunk's block — and the decode loop grows it
+        block-by-block against the pool."""
         if self.draining:
             raise QueueFullError(
                 "server is draining", reason="drain", retry_after_s=5.0
             )
+        if self.paged and self.pool is not None:
+            initial, worst = self.engine.kv_blocks_estimate(feats)
+            if worst > self.pool.num_blocks:
+                raise QueueFullError(
+                    f"request needs {worst} KV blocks, pool holds "
+                    f"{self.pool.num_blocks}",
+                    reason="kv_budget",
+                )
+            if self.pool.free_blocks < initial and klass == INTERACTIVE:
+                # Transient pressure: wait it out in the lower class.
+                klass = BATCH
+            return klass, initial * self.pool.block_bytes
         kv = self.kv_bytes(feats)
         if self.kv_budget_bytes:
             if kv > self.kv_budget_bytes:
@@ -100,7 +153,22 @@ class AdmissionController:
         return klass, kv
 
     def fits(self, item) -> bool:
-        """Dequeue gate: may this waiter's KV reservation commit now?"""
+        """Dequeue gate: may this waiter's KV reservation commit now?
+
+        Paged streams gate on FREE POOL BLOCKS for their initial
+        commitment (the exact ledger); non-stream batch work keeps the
+        byte ledger, measured against what the pool hasn't claimed."""
+        if self.paged and self.pool is not None:
+            if getattr(item, "is_stream", False):
+                need = -(-getattr(item, "kv", 0) // self.pool.block_bytes)
+                return self.pool.free_blocks >= need
+            if not self.kv_budget_bytes:
+                return True
+            with self._lock:
+                return (
+                    self._committed + getattr(item, "kv", 0)
+                    + self._pool_bytes() <= self.kv_budget_bytes
+                )
         if not self.kv_budget_bytes:
             return True
         with self._lock:
@@ -108,21 +176,34 @@ class AdmissionController:
                 <= self.kv_budget_bytes
 
     def reserve(self, item) -> None:
+        if self.paged and getattr(item, "is_stream", False):
+            # The pool is the ledger: blocks commit at slot insert and
+            # grow at chunk boundaries (engine/streams.py); nothing to
+            # reserve here beyond refreshing the gauge.
+            self.note_pool()
+            return
         kv = getattr(item, "kv", 0)
         if kv and not item.kv_held:
             with self._lock:
                 self._committed += kv
-                metrics.KV_COMMITTED.labels(self.model).set(self._committed)
+                metrics.KV_COMMITTED.labels(self.model).set(
+                    self._committed + self._pool_bytes()
+                )
             item.kv_held = True
 
     def release(self, item) -> None:
+        if self.paged and getattr(item, "is_stream", False):
+            self.note_pool()
+            return
         if getattr(item, "kv_held", False):
             with self._lock:
                 self._committed -= item.kv
-                metrics.KV_COMMITTED.labels(self.model).set(self._committed)
+                metrics.KV_COMMITTED.labels(self.model).set(
+                    self._committed + self._pool_bytes()
+                )
             item.kv_held = False
 
     @property
     def committed_bytes(self) -> int:
         with self._lock:
-            return self._committed
+            return self._committed + self._pool_bytes()

@@ -197,6 +197,20 @@ class ServiceConfig(BaseModel):
     # Streams allowed to WAIT (deadline-queued) beyond max_streams
     # active; 0 restores the historical instant 503 past max_streams.
     max_stream_queue: int = 0
+    # Block-paged KV cache (decoder families, continuous batching):
+    # the shared decode loop's KV lives in a pool of KV_BLOCK_SIZE-token
+    # blocks with per-slot block tables instead of per-slot contiguous
+    # slabs.  Admission then charges a stream only its prompt blocks
+    # plus the first chunk's block, grows block-by-block at chunk
+    # boundaries, frees every block the moment the stream ends (early
+    # EOS, cancel, preemption), and prefix-cache hits SHARE the donor's
+    # prompt blocks by refcount instead of copying — which is what
+    # turns KV_BUDGET_MB from a worst-case gate into live-token
+    # occupancy (docs/kv-paging.md).  Default off = the seed layout.
+    paged_kv: bool = False
+    # Tokens per KV block in paged mode.  Must divide every seq bucket
+    # (prefix sharing relies on bucket-aligned block boundaries).
+    kv_block_size: int = 16
     # Interactive arrivals may preempt batch-class streams (checkpoint
     # the cursor, free the slot, re-queue for token-identical resume)
     # when every slot is busy.  Only reachable with MAX_STREAM_QUEUE>0.
@@ -288,6 +302,13 @@ class ServiceConfig(BaseModel):
             raise ValueError("CLASS_WEIGHT must be >= 1")
         return v
 
+    @field_validator("kv_block_size")
+    @classmethod
+    def _check_kv_block_size(cls, v: int) -> int:
+        if not (1 <= v <= 1024):
+            raise ValueError("KV_BLOCK_SIZE must be in [1, 1024]")
+        return v
+
 
 def _env(name: str, default: str | None = None) -> str | None:
     v = os.environ.get(name)
@@ -305,7 +326,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX,
       SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
-      DRAIN_GRACE_S.
+      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE.
     """
     e = dict(os.environ)
     if env:
@@ -350,6 +371,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "stream_pipeline": "STREAM_PIPELINE",
         "class_weight": "CLASS_WEIGHT",
         "max_stream_queue": "MAX_STREAM_QUEUE",
+        "kv_block_size": "KV_BLOCK_SIZE",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -372,6 +394,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PREEMPT")
     if v is not None:
         kwargs["preempt"] = v.lower() not in ("0", "false", "no")
+    v = get("PAGED_KV")
+    if v is not None:
+        kwargs["paged_kv"] = v.lower() not in ("0", "false", "no")
     # Comma-separated bucket overrides, e.g. BATCH_BUCKETS=1,8,32 — used
     # to bound warmup compile time when only some shapes will be served.
     for field, var in (("batch_buckets", "BATCH_BUCKETS"), ("seq_buckets", "SEQ_BUCKETS")):

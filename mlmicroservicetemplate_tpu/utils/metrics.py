@@ -117,6 +117,17 @@ KV_COMMITTED = Gauge(
     "KV-cache bytes currently committed against the admission budget",
     ["model"],
 )
+KV_POOL_BLOCKS = Gauge(
+    "kv_pool_blocks",
+    "Paged-KV pool blocks by state (used includes prefix-cache pins)",
+    ["model", "state"],
+)
+KV_GROWTH_STALLS = Counter(
+    "kv_growth_stalls_total",
+    "Paged-KV decode growth found the pool dry: the stream was "
+    "checkpointed and re-queued (resumes when blocks free up)",
+    ["model"],
+)
 
 
 def render() -> tuple[bytes, str]:

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-entry-point repo check: byte-compile, lint (when ruff is
+# installed), then the tier-1 pytest command from ROADMAP.md.
+#
+#   scripts/check.sh            # full: compile + lint + tier-1 tests
+#   scripts/check.sh --fast     # compile + lint only (skip pytest)
+#
+# Exits non-zero on the first failing stage.  Ruff is OPTIONAL: this
+# container doesn't ship it and nothing may be pip-installed here, so
+# a missing ruff is a warning, not a failure — CI images that have it
+# get the lint gate for free ([tool.ruff] in pyproject.toml).
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== compileall =="
+python -m compileall -q mlmicroservicetemplate_tpu tests benchmarks || exit 1
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check mlmicroservicetemplate_tpu tests benchmarks || exit 1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check mlmicroservicetemplate_tpu tests benchmarks || exit 1
+else
+    echo "ruff not installed; skipping lint (config lives in pyproject.toml)"
+fi
+
+if [ "$1" = "--fast" ]; then
+    echo "== tier-1 tests skipped (--fast) =="
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit $rc

@@ -138,6 +138,70 @@ def tiny_t5_bundle(seed: int = 0) -> ModelBundle:
     )
 
 
+TINY_GPT = dict(
+    vocab_size=300, d_model=32, num_heads=2, num_layers=2, d_ff=64,
+    max_position=256, eos_id=257, pad_id=257,
+)
+TINY_LLAMA = dict(
+    vocab_size=300, d_model=32, num_heads=4, num_kv_heads=2, num_layers=2,
+    d_ff=64, max_position=256, eos_id=257, pad_id=257,
+)
+
+
+def tiny_gpt_bundle(seed: int = 0) -> ModelBundle:
+    """Tiny decoder-only bundle with the full fn surface the engine
+    serves (contiguous chunk + paged chunk), for loop/scheduler tests."""
+    import jax
+
+    from mlmicroservicetemplate_tpu.models import gpt as gpt_mod
+    from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
+
+    cfg = gpt_mod.GPTConfig(**TINY_GPT)
+    params = gpt_mod.init_params(jax.random.PRNGKey(seed), cfg)
+    return ModelBundle(
+        name="gpt2", kind=KIND_SEQ2SEQ, cfg=cfg, params=params,
+        policy=default_policy("cpu"), tokenizer=ByteTokenizer(add_eos=True),
+        labels=None, forward=None,
+        encode_fn=lambda p, i, m: i,
+        init_state_fn=lambda p, i, m, ml, sample=None: gpt_mod.init_decode_state(
+            p, cfg, i, m, ml, sample=sample
+        ),
+        generate_chunk_fn=lambda p, s, n, sample=False: gpt_mod.generate_chunk(
+            p, cfg, s, n, sample
+        ),
+        paged_chunk_fn=lambda p, s, t, n, sample=False: gpt_mod.generate_chunk_paged(
+            p, cfg, s, t, n, sample
+        ),
+        supports_prefix=True,
+    )
+
+
+def tiny_llama_bundle(seed: int = 0, kv_quant: bool = False) -> ModelBundle:
+    import jax
+
+    from mlmicroservicetemplate_tpu.models import llama as llama_mod
+    from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
+
+    cfg = llama_mod.LlamaConfig(**TINY_LLAMA, kv_quant=kv_quant)
+    params = llama_mod.init_params(jax.random.PRNGKey(seed), cfg)
+    return ModelBundle(
+        name="llama", kind=KIND_SEQ2SEQ, cfg=cfg, params=params,
+        policy=default_policy("cpu"), tokenizer=ByteTokenizer(add_eos=True),
+        labels=None, forward=None,
+        encode_fn=lambda p, i, m: i,
+        init_state_fn=lambda p, i, m, ml, sample=None: llama_mod.init_decode_state(
+            p, cfg, i, m, ml, sample=sample
+        ),
+        generate_chunk_fn=lambda p, s, n, sample=False: llama_mod.generate_chunk(
+            p, cfg, s, n, sample
+        ),
+        paged_chunk_fn=lambda p, s, t, n, sample=False: llama_mod.generate_chunk_paged(
+            p, cfg, s, t, n, sample
+        ),
+        supports_prefix=True,
+    )
+
+
 def rand_image(seed: int = 0, size: int = 32) -> np.ndarray:
     rng = np.random.default_rng(seed)
     return rng.integers(0, 255, (size, size, 3), dtype=np.uint8)

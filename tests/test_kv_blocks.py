@@ -1,0 +1,173 @@
+"""Paged-KV bookkeeping tests (engine/kv_blocks.py) + the admission
+estimate invariants the scheduler relies on.
+
+The fail-safe contract: the contiguous ceiling estimate
+(`kv_bytes_estimate`) must bound the paged exact ledger
+(`kv_blocks_estimate × block bytes`) from above for every prompt
+length, decode budget, quant mode and model family — that gap is
+exactly the occupancy paged mode wins back."""
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.kv_blocks import (
+    BlockPool,
+    OutOfBlocks,
+    PagedPrefix,
+    StreamBlocks,
+    blocks_for,
+    kv_token_bytes,
+)
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import tiny_gpt_bundle, tiny_llama_bundle, tiny_t5_bundle
+
+
+def test_blocks_for():
+    assert blocks_for(0, 16) == 0
+    assert blocks_for(1, 16) == 1
+    assert blocks_for(16, 16) == 1
+    assert blocks_for(17, 16) == 2
+
+
+def test_pool_alloc_free_refcount():
+    pool = BlockPool(4, block_bytes=100)
+    a = pool.alloc(3)
+    assert len(a) == 3 and pool.free_blocks == 1 and pool.used_bytes == 300
+    # All-or-nothing: an unsatisfiable alloc takes nothing.
+    with pytest.raises(OutOfBlocks):
+        pool.alloc(2)
+    assert pool.free_blocks == 1
+    # CoW: a second holder keeps the block allocated past the first free.
+    pool.ref(a[:1])
+    pool.free(a)
+    assert pool.free_blocks == 3  # a[0] still held by the extra ref
+    assert pool.refcount(a[0]) == 1
+    pool.free(a[:1])
+    assert pool.free_blocks == 4
+    with pytest.raises(ValueError):
+        pool.free(a[:1])  # double free is a ledger bug, never silent
+
+
+def test_stream_blocks_adopt_grow_release():
+    pool = BlockPool(8)
+    donor = StreamBlocks(pool, block_size=4)
+    donor.ensure(8)  # 2 blocks
+    shared = list(donor.ids)
+    pool.ref(shared)  # the cache pin
+
+    sharer = StreamBlocks(pool, block_size=4)
+    sharer.adopt(shared)
+    assert sharer.tokens_capacity == 8 and pool.used_blocks == 2
+    fresh = sharer.ensure(13)  # needs 4 blocks total -> 2 fresh
+    assert len(fresh) == 2 and pool.used_blocks == 4
+    assert sharer.ensure(13) == []  # already covered
+
+    donor.release()
+    assert pool.used_blocks == 4  # shared blocks held by pin + sharer
+    sharer.release()
+    sharer.release()  # idempotent
+    assert pool.used_blocks == 2  # only the pin remains
+    pool.free(shared)
+    assert pool.used_blocks == 0
+
+
+def test_paged_prefix_entry_carries_bytes():
+    e = PagedPrefix(32, (1, 2), 4096)
+    assert e.nbytes == 4096 and e.p_len == 32
+
+
+def _engine(bundle, **kw):
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2))
+    kw.setdefault("seq_buckets", (16, 32, 64))
+    kw.setdefault("max_decode_len", 12)
+    kw.setdefault("stream_chunk_tokens", 4)
+    cfg = ServiceConfig(**kw)
+    return InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+
+
+def test_kv_estimate_nonzero_for_decoder_only():
+    """Decoder-only causal LMs (gpt2/llama) register as seq2seq and
+    MUST yield a non-zero KV estimate — a 0 silently no-ops admission
+    for the families that carry the composed decode levers."""
+    for bundle in (tiny_gpt_bundle(), tiny_llama_bundle()):
+        eng = _engine(bundle)
+        assert eng.kv_bytes_estimate({"length": 10}) > 0, bundle.name
+
+
+def test_kv_estimate_counts_global_prefix_rows():
+    """A global PROMPT_PREFIX occupies cache rows in EVERY stream's
+    state; the admission ceiling must include them or it undershoots
+    (the fail-safe breaks)."""
+    import jax.numpy as jnp
+
+    bundle = tiny_gpt_bundle()
+    eng0 = _engine(bundle)
+    base = eng0.kv_bytes_estimate({"length": 10})
+
+    p_len = 32
+    h = bundle.cfg.num_heads
+    d = bundle.cfg.head_dim
+    pre = {
+        "k": [jnp.zeros((1, p_len, h, d)) for _ in range(bundle.cfg.num_layers)],
+        "v": [jnp.zeros((1, p_len, h, d)) for _ in range(bundle.cfg.num_layers)],
+    }
+    bundle_pre = tiny_gpt_bundle()
+    bundle_pre.params = dict(bundle_pre.params, __prefix__=pre)
+    eng1 = _engine(bundle_pre)
+    got = eng1.kv_bytes_estimate({"length": 10})
+    assert got == base + p_len * eng1.kv_token_bytes()
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama", "llama-int8"])
+def test_ceiling_estimate_bounds_paged_blocks(family):
+    """Property: for every (prompt length, decode budget) the ceiling
+    estimate bounds the paged ledger to within ONE block (the paged
+    tax is internal fragmentation of the final partial block, strictly
+    < KV_BLOCK_SIZE tokens per stream) — the fail-safe the scheduler
+    relies on: paged admission can never commit meaningfully more than
+    the contiguous ceiling would have, while typically committing far
+    less (initial << worst until decode actually grows)."""
+    if family == "gpt":
+        bundle, quant = tiny_gpt_bundle(), None
+    elif family == "llama":
+        bundle, quant = tiny_llama_bundle(), None
+    else:
+        bundle, quant = tiny_llama_bundle(kv_quant=True), "int8"
+    eng = _engine(bundle, paged_kv=True, kv_block_size=16, quant_kv=quant)
+    bb = eng.kv_pool.block_bytes
+    assert bb == eng.kv_token_bytes() * 16
+    for length in (1, 5, 16, 17, 31, 32, 50, 64):
+        for max_tokens in (1, 3, 4, 11, 12):
+            feats = {"length": length, "max_tokens": max_tokens}
+            initial, worst = eng.kv_blocks_estimate(feats)
+            assert 0 < initial <= worst
+            est = eng.kv_bytes_estimate(feats)
+            # Ceiling covers every live token the blocks can hold...
+            assert est + bb > worst * bb, (family, length, max_tokens)
+            # ...and the initial commitment is the real win: prompt
+            # blocks + first chunk (same one-block fragmentation
+            # bound), not prompt bucket + FULL budget.
+            assert initial * bb < est + bb
+
+
+def test_kv_token_bytes_quant_math():
+    # f32: D*4 per head, K+V, layers*heads
+    assert kv_token_bytes(2, 2, 16, 4) == 2 * 2 * 2 * 16 * 4
+    # int8: D*1 payload + 4B scale per token-head
+    assert kv_token_bytes(2, 2, 16, 4, quant_int8=True) == 2 * 2 * 2 * (16 + 4)
+
+
+def test_seq2seq_estimate_unchanged_for_t5():
+    """The estimate refactor must not move the t5 number (no global
+    prefix, cross-attention term intact)."""
+    eng = _engine(tiny_t5_bundle())
+    cfg = eng.bundle.cfg
+    per_tok = 2 * cfg.num_layers * cfg.num_heads * cfg.d_kv * 4
+    s = 16  # bucketed from length 10
+    want = (s + eng.max_decode_len) * per_tok + s * per_tok
+    assert eng.kv_bytes_estimate({"length": 10}) == want

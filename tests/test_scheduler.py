@@ -561,3 +561,89 @@ def test_drain_rejects_new_and_finishes_inflight():
             await client.close()
 
     asyncio.run(main())
+
+
+def test_preempt_checkpoint_releases_kv_and_refreshes_footprint():
+    """A checkpointed (preempted) stream must hold ZERO ledger
+    commitment while it waits to resume, and the recast path — which
+    folds delivered tokens into the prompt — must refresh the
+    footprint it will re-reserve, not re-commit the stale
+    admission-time estimate."""
+    import dataclasses
+
+    from helpers import tiny_gpt_bundle
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+    from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+    bundle = dataclasses.replace(tiny_gpt_bundle(), supports_prefix=True)
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(16, 32, 64), max_decode_len=24,
+        stream_chunk_tokens=4, max_streams=1, max_stream_queue=4,
+        preempt=True, kv_budget_mb=64.0,
+    )
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.admission = AdmissionController(cfg, eng)
+
+    batch_feats = {
+        "input_ids": np.arange(5, 19, dtype=np.int32), "length": np.int32(14)
+    }
+    inter_feats = {
+        "input_ids": np.arange(30, 38, dtype=np.int32), "length": np.int32(8)
+    }
+    ref_batch = np.concatenate(list(eng.generate_stream(dict(batch_feats))))
+
+    captured = {}
+    orig_requeue = cdl._requeue_preempted
+
+    def spy(st):
+        # The caller released the victim's reservation BEFORE this
+        # call; the interactive waiter reserves only at dequeue — so
+        # a correct ledger reads zero right here.
+        captured["committed_at_checkpoint"] = cdl.admission.committed_bytes
+        captured["kv_before"] = st.kv
+        orig_requeue(st)
+        captured["kv_after"] = st.kv
+        captured["len_after"] = int(st.feats["length"])
+
+    cdl._requeue_preempted = spy
+
+    orig_chunk = eng._gen_chunk
+
+    def slow_chunk(*a, **k):
+        time.sleep(0.05)
+        return orig_chunk(*a, **k)
+
+    eng._gen_chunk = slow_chunk
+
+    async def _collect(gen):
+        out = []
+        async for c in gen:
+            out.append(np.asarray(c))
+        return np.concatenate(out) if out else np.zeros(0, np.int32)
+
+    async def body():
+        g_b = cdl.submit_stream(dict(batch_feats, priority="batch"))
+        first = np.asarray(await g_b.__anext__())
+        g_i = cdl.submit_stream(dict(inter_feats, priority="interactive"))
+        out_i = await _collect(g_i)
+        rest = await _collect(g_b)
+        return out_i, np.concatenate([first, rest])
+
+    try:
+        _, out_b = asyncio.run(body())
+    finally:
+        eng._gen_chunk = orig_chunk
+        cdl.stop()
+    assert cdl.preemptions >= 1
+    np.testing.assert_array_equal(out_b, ref_batch)
+    assert captured["committed_at_checkpoint"] == 0
+    # Recast folded delivered tokens into the prompt (length grew)...
+    assert captured["len_after"] > 14
+    # ...and the footprint was refreshed off the NEW feats.
+    assert captured["kv_after"] == eng.kv_bytes_estimate(
+        {"length": captured["len_after"]}
+    )
