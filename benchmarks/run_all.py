@@ -131,6 +131,16 @@ async def main() -> None:
             check=False,
         )
 
+    # Fault recovery (round-9 tentpole): goodput + p99 TTFT under an
+    # injected fault schedule, supervised (watchdog + checkpoint/
+    # rebuild/resume) vs the unsupervised seed behavior.  FAULT_AB=0
+    # skips.
+    if os.environ.get("FAULT_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "fault_recovery_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

@@ -16,6 +16,7 @@ import logging
 import math
 import os
 import time
+import uuid
 
 import numpy as np
 from aiohttp import web
@@ -38,8 +39,60 @@ K_STARTED_AT = web.AppKey("started_at", float)
 K_STATE = web.AppKey("state", dict)
 
 
+def _error_body(etype: str, message: str, rid: str) -> dict:
+    """The structured error shape every failure path speaks:
+    ``{"error": {"type", "message", "request_id"}}``."""
+    return {"error": {"type": etype, "message": message, "request_id": rid}}
+
+
+def _internal_error(request: web.Request, message: str,
+                    exc: BaseException | None = None) -> web.HTTPInternalServerError:
+    """Structured 500: JSON error body + X-Request-Id, never a raw
+    aiohttp error page."""
+    rid = request.get("request_id", "")
+    etype = type(exc).__name__ if exc is not None else "InternalServerError"
+    return web.HTTPInternalServerError(
+        text=json.dumps(_error_body(etype, message, rid)),
+        content_type="application/json",
+        headers={"X-Request-Id": rid},
+    )
+
+
+@web.middleware
+async def request_id_middleware(request: web.Request, handler):
+    """Echo (or mint) X-Request-Id on every response and convert any
+    exception no handler mapped into the structured JSON 500 body —
+    the log line and the client error share the same request_id, so an
+    operator can find the traceback for any failed call."""
+    rid = request.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
+    request["request_id"] = rid
+    try:
+        resp = await handler(request)
+    except web.HTTPException as e:
+        e.headers.setdefault("X-Request-Id", rid)
+        raise
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:
+        bundle = request.app[K_BUNDLE]
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception(
+            "unhandled error on %s (request_id=%s)", request.path, rid
+        )
+        return web.json_response(
+            _error_body(type(e).__name__, str(e) or "internal error", rid),
+            status=500, headers={"X-Request-Id": rid},
+        )
+    if not resp.prepared:
+        resp.headers.setdefault("X-Request-Id", rid)
+    return resp
+
+
 def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Application:
-    app = web.Application(client_max_size=32 * 1024 * 1024)
+    app = web.Application(
+        client_max_size=32 * 1024 * 1024,
+        middlewares=[request_id_middleware],
+    )
     app[K_CFG] = cfg
     app[K_BUNDLE] = bundle
     app[K_ENGINE] = engine
@@ -140,7 +193,24 @@ async def _canary(app: web.Application) -> None:
         feats = {"image": np.zeros((bundle.image_size, bundle.image_size, 3), np.uint8)}
     else:
         feats = {"input_ids": np.ones(8, np.int32), "length": np.int32(8)}
-    await app[K_BATCHER].submit(feats)
+    # The probe dispatch runs under the engine watchdog (the batcher's
+    # guarded batch path), so a wedged device raises
+    # DispatchTimeoutError at DISPATCH_TIMEOUT_S and flips /readyz
+    # unready via warm_then_ready's error capture.  The asyncio-level
+    # bound is the backstop for a hang that wedges OUTSIDE guarded
+    # code (margin: queue wait + transient retries).
+    timeout = float(getattr(app[K_CFG], "dispatch_timeout_s", 0.0) or 0.0)
+    coro = app[K_BATCHER].submit(feats)
+    if timeout > 0:
+        try:
+            await asyncio.wait_for(coro, timeout * 2 + 5.0)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"canary dispatch exceeded DISPATCH_TIMEOUT_S={timeout}s; "
+                "device wedged?"
+            )
+    else:
+        await coro
 
 
 async def _on_cleanup(app: web.Application) -> None:
@@ -327,12 +397,16 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
     except DeadlineExceededError:
         metrics.REQUESTS.labels(bundle.name, "504").inc()
         raise _deadline_response()
-    except Exception:
-        # Engine/dispatch failure: surface as a clean 500 (with a metric
-        # and a server-side traceback), not an opaque aiohttp error page.
+    except Exception as e:
+        # Engine/dispatch failure: surface as a structured 500 (with a
+        # metric and a server-side traceback sharing the request_id),
+        # not an opaque aiohttp error page.
         metrics.REQUESTS.labels(bundle.name, "500").inc()
-        log.exception("inference dispatch failed")
-        raise web.HTTPInternalServerError(reason="inference failed")
+        log.exception(
+            "inference dispatch failed (request_id=%s)",
+            request.get("request_id", ""),
+        )
+        raise _internal_error(request, "inference failed", e)
     dt = time.monotonic() - t0
     result["model"] = bundle.name
     result["timing_ms"] = round(dt * 1000.0, 3)
@@ -459,7 +533,7 @@ async def _delta_stream(bundle: ModelBundle, stream_iter, item: RawItem):
     }
 
 
-async def _open_stream(app, bundle: ModelBundle, feats: dict, item: RawItem,
+async def _open_stream(request: web.Request, feats: dict, item: RawItem,
                        t0: float):
     """Open a stream and pull its FIRST event before any response bytes
     go out: a stream that queued under the scheduler and was then shed
@@ -468,6 +542,8 @@ async def _open_stream(app, bundle: ModelBundle, feats: dict, item: RawItem,
     observation point.  Returns (event_iterator, stream_iter)."""
     from ..engine.streams import StreamClosedError
 
+    app = request.app
+    bundle: ModelBundle = app[K_BUNDLE]
     try:
         stream_iter = app[K_BATCHER].submit_stream(feats)
     except QueueFullError as e:
@@ -491,7 +567,7 @@ async def _open_stream(app, bundle: ModelBundle, feats: dict, item: RawItem,
     except StopAsyncIteration:
         # _delta_stream always yields a final event; defensive.
         metrics.REQUESTS.labels(bundle.name, "500").inc()
-        raise web.HTTPInternalServerError(reason="stream produced no events")
+        raise _internal_error(request, "stream produced no events")
     metrics.TTFT.labels(bundle.name).observe(time.monotonic() - t0)
 
     async def chained():
@@ -508,10 +584,12 @@ async def _stream_predict(
     """Chunked seq2seq streaming: ndjson lines of decoded-token deltas."""
     app = request.app
     bundle: ModelBundle = app[K_BUNDLE]
-    events, stream_iter = await _open_stream(app, bundle, feats, item, t0)
+    rid = request.get("request_id", "")
+    events, stream_iter = await _open_stream(request, feats, item, t0)
     resp = web.StreamResponse(
         status=200,
-        headers={"Content-Type": "application/x-ndjson", "X-Accel-Buffering": "no"},
+        headers={"Content-Type": "application/x-ndjson",
+                 "X-Accel-Buffering": "no", "X-Request-Id": rid},
     )
     resp.enable_chunked_encoding()
     await resp.prepare(request)
@@ -548,6 +626,22 @@ async def _stream_predict(
             )
             metrics.REQUESTS.labels(bundle.name, "200").inc()
             metrics.LATENCY.labels(bundle.name).observe(dt)
+    except ConnectionError:
+        pass  # client disconnected mid-write; nothing left to tell it
+    except Exception as e:
+        # Mid-stream failure AFTER the 200 went out: the only honest
+        # signal left is a terminal in-band error line (same structured
+        # shape as the unary JSON error body).
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception("stream failed mid-flight (request_id=%s)", rid)
+        try:
+            await resp.write(
+                (json.dumps(_error_body(
+                    type(e).__name__, str(e) or "stream failed", rid
+                )) + "\n").encode()
+            )
+        except ConnectionError:
+            pass
     finally:
         await stream_iter.aclose()
         try:
@@ -575,11 +669,13 @@ def _usage(feats: dict, completion_tokens: int) -> dict:
     }
 
 
-async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
+async def _generate_once(request: web.Request, feats: dict, item: RawItem):
     """Non-stream generation shared by /v1/completions and chat:
     submit → trim to max_tokens → apply stop strings → finish_reason.
     Returns (text, finish_reason, completion_token_count); maps
     failures to metered HTTP errors."""
+    app = request.app
+    bundle: ModelBundle = app[K_BUNDLE]
     loop = asyncio.get_running_loop()
     try:
         row = await app[K_BATCHER].submit(feats)
@@ -617,10 +713,13 @@ async def _generate_once(app, bundle: ModelBundle, feats: dict, item: RawItem):
     except DeadlineExceededError:
         metrics.REQUESTS.labels(bundle.name, "504").inc()
         raise _deadline_response()
-    except Exception:
+    except Exception as e:
         metrics.REQUESTS.labels(bundle.name, "500").inc()
-        log.exception("completion failed")
-        raise web.HTTPInternalServerError(reason="inference failed")
+        log.exception(
+            "completion failed (request_id=%s)",
+            request.get("request_id", ""),
+        )
+        raise _internal_error(request, "inference failed", e)
 
 
 async def _openai_prologue(request: web.Request, to_prompt):
@@ -673,8 +772,8 @@ async def _openai_prologue(request: web.Request, to_prompt):
         })
     except LookupError as e:
         metrics.REQUESTS.labels(bundle.name, "500").inc()
-        log.error("%s", e)
-        raise web.HTTPInternalServerError(reason=str(e))
+        log.error("%s (request_id=%s)", e, request.get("request_id", ""))
+        raise _internal_error(request, str(e), e)
     except ValueError as e:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=str(e))
@@ -716,11 +815,13 @@ async def _sse_stream(request, feats, item, t0, events, preamble=None):
     (chat's role chunk)."""
     app = request.app
     bundle: ModelBundle = app[K_BUNDLE]
-    ev_iter, stream_iter = await _open_stream(app, bundle, feats, item, t0)
+    rid = request.get("request_id", "")
+    ev_iter, stream_iter = await _open_stream(request, feats, item, t0)
     resp = web.StreamResponse(
         status=200,
         headers={"Content-Type": "text/event-stream",
-                 "Cache-Control": "no-cache", "X-Accel-Buffering": "no"},
+                 "Cache-Control": "no-cache", "X-Accel-Buffering": "no",
+                 "X-Request-Id": rid},
     )
     resp.enable_chunked_encoding()
     await resp.prepare(request)
@@ -734,6 +835,21 @@ async def _sse_stream(request, feats, item, t0, events, preamble=None):
                 await resp.write(b"data: [DONE]\n\n")
                 metrics.REQUESTS.labels(bundle.name, "200").inc()
                 metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
+    except ConnectionError:
+        pass  # client disconnected mid-write
+    except Exception as e:
+        # Terminal SSE error event before close — a structured signal
+        # instead of an abrupt connection drop mid-200.
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception("SSE stream failed mid-flight (request_id=%s)", rid)
+        try:
+            await resp.write(
+                b"event: error\ndata: " + json.dumps(_error_body(
+                    type(e).__name__, str(e) or "stream failed", rid
+                )).encode() + b"\n\n"
+            )
+        except ConnectionError:
+            pass
     finally:
         await stream_iter.aclose()
         try:
@@ -789,7 +905,7 @@ async def handle_completions(request: web.Request) -> web.StreamResponse:
 
         return await _sse_stream(request, feats, item, t0, events)
 
-    text, finish, n_tok = await _generate_once(app, bundle, feats, item)
+    text, finish, n_tok = await _generate_once(request, feats, item)
     metrics.REQUESTS.labels(bundle.name, "200").inc()
     metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
     return web.json_response({
@@ -857,7 +973,7 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
             preamble=chunk({"role": "assistant"}, None),
         )
 
-    text, finish, n_tok = await _generate_once(app, bundle, feats, item)
+    text, finish, n_tok = await _generate_once(request, feats, item)
     metrics.REQUESTS.labels(bundle.name, "200").inc()
     metrics.LATENCY.labels(bundle.name).observe(time.monotonic() - t0)
     return web.json_response({
@@ -901,6 +1017,16 @@ async def handle_healthz(request: web.Request) -> web.Response:
 
 
 async def handle_readyz(request: web.Request) -> web.Response:
+    sup = getattr(request.app[K_BATCHER], "supervisor", None)
+    if sup is not None and sup.failed:
+        # The engine crash-looped through its whole restart budget:
+        # permanently unready so the LB stops routing here for good.
+        return web.json_response(
+            {"ready": False,
+             "error": "engine restart budget exhausted "
+                      "(ENGINE_RESTARTS_MAX)"},
+            status=503,
+        )
     if request.app[K_BATCHER].draining:
         # Load balancers stop routing here while in-flight work drains.
         return web.json_response(
@@ -968,6 +1094,8 @@ async def handle_status(request: web.Request) -> web.Response:
         "kv_committed_bytes": batcher.admission.committed_bytes,
         "kv_budget_bytes": batcher.admission.kv_budget_bytes,
     }
+    if batcher.supervisor is not None:
+        body["fault_tolerance"] = batcher.supervisor.stats()
     err = app[K_STATE]["ready_error"]
     if err:
         body["ready_error"] = err

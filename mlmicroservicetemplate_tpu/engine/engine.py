@@ -52,6 +52,24 @@ class InferenceEngine:
 
         self.bundle = bundle
         self.cfg = cfg
+        # Fault tolerance (engine/faults.py): a deterministic injector
+        # around the dispatch boundaries (FAULT_SPEC; None = off, zero
+        # overhead) and a watchdog (deadline + transient retry) every
+        # guarded dispatch runs under.  A malformed FAULT_SPEC fails
+        # HERE — at startup, before readiness — not on the Nth dispatch.
+        from .faults import FaultInjector, Watchdog
+
+        self.faults = FaultInjector.from_spec(
+            getattr(cfg, "fault_spec", None),
+            int(getattr(cfg, "fault_seed", 0) or 0),
+        )
+        self.watchdog = Watchdog(
+            bundle.name,
+            timeout_s=float(getattr(cfg, "dispatch_timeout_s", 0.0) or 0.0),
+            retries=int(getattr(cfg, "dispatch_retries", 2)),
+            backoff_s=float(getattr(cfg, "dispatch_backoff_s", 0.05)),
+            injector=self.faults,
+        )
         if replicas is not None:
             self.replicas = replicas
         elif bundle.make_placement is not None:
@@ -540,6 +558,46 @@ class InferenceEngine:
         for i, f in enumerate(feats):
             budgets[i] = self.budget_for(f)
         return budgets
+
+    # ------------------------------------------------------------------
+    # fault tolerance
+
+    def dispatch_guard(self, site: str, fn):
+        """Run one device-dispatch callable under the fault injector
+        and the watchdog (deadline + transient retry).  Every guarded
+        callable is functional — jitted calls and fetches with no
+        donation — so a retry is token-identical by construction."""
+        return self.watchdog.run(site, fn)
+
+    def fault_point(self, site: str) -> None:
+        """Bare injection point for non-dispatch boundaries (e.g. the
+        paged allocator's ``grow`` site, where an injected
+        ``OutOfBlocks`` exercises the checkpoint-and-requeue path)."""
+        if self.faults is not None:
+            self.faults.fire(site)
+
+    def reset_device_state(self) -> None:
+        """Crash-recovery rebuild of everything living on the device:
+        flush the prefix cache (its entries name buffers — or block
+        ids — of the state being torn down), re-create the paged KV
+        pool, re-place params.  Compiled executables survive (the
+        process didn't die), so the rebuilt engine is warm: the first
+        post-restart admission pays a device upload, not a compile.
+        Caller (the decode loop's recovery path) owns dropping its own
+        slot state and re-pointing at the fresh pool."""
+        # Flush BEFORE swapping the pool: paged pins free through
+        # on_evict into whatever ``kv_pool`` currently points at, and
+        # those block ids belong to the OLD pool.
+        if self.prefix_cache is not None:
+            while self.prefix_cache.pop_lru() is not None:
+                pass
+        if self.paged_kv and self.kv_pool is not None:
+            from .kv_blocks import BlockPool
+
+            self.kv_pool = BlockPool(
+                self.kv_pool.num_blocks, self.kv_pool.block_bytes
+            )
+        self.params = self.replicas.place_params(self.bundle.params)
 
     # ------------------------------------------------------------------
     # dispatch

@@ -1,0 +1,272 @@
+"""Fault injection + dispatch watchdog for fault-tolerant serving.
+
+Two cooperating pieces, both OFF by default:
+
+- **FaultInjector** (``FAULT_SPEC``): a deterministic, seedable fault
+  schedule wrapped around the device-dispatch boundaries (the
+  continuous loop's prefill/chunk/fetch sites, the batcher's batch
+  site, the paged allocator's grow site).  It can raise transient
+  device errors, raise fatal "device lost" errors, inject hangs
+  (sleeps longer than the watchdog deadline), and force
+  ``OutOfBlocks`` — on the Nth matching dispatch or at a seeded
+  Bernoulli rate.  With no spec the injector is ``None`` and every
+  call site skips it entirely (zero overhead).
+
+- **Watchdog** (``DISPATCH_TIMEOUT_S`` / ``DISPATCH_RETRIES`` /
+  ``DISPATCH_BACKOFF_S``): runs one dispatch callable under a
+  monitored deadline and retries transient failures with capped
+  exponential backoff.  Every guarded callable here is functional
+  (jitted calls and fetches: same inputs → same outputs, no donation
+  on these paths), so a retry is token-identical by construction.
+  A deadline overrun raises ``DispatchTimeoutError`` — classified
+  FATAL, because a wedged dispatch on the same device state will not
+  unwedge by retrying; the supervisor (engine/supervisor.py) rebuilds
+  instead.  With timeout 0 and retries 0 and no injector, ``run`` is
+  a plain passthrough call.
+
+FAULT_SPEC grammar (``;``-separated rules)::
+
+    rule   := [site ":"] kind ["(" seconds ")"] trigger
+    site   := prefill | chunk | fetch | batch | grow | *   (default *)
+    kind   := transient | fatal | hang | oob
+    trigger:= "@" N ["+" M]   fire on matching dispatches N..N+M-1
+            | "~" RATE        fire with probability RATE per dispatch
+                              (seeded RNG: FAULT_SEED)
+
+``seconds`` only applies to ``hang`` (default 3600).  Examples:
+``chunk:fatal@5`` kills the 5th chunk dispatch;
+``chunk:transient@2+3`` fails chunks 2-4 transiently;
+``*:transient~0.05`` fails 5% of all dispatches.  ``@N`` counters are
+per rule and count only dispatches at the rule's site, so a schedule
+is reproducible run-to-run regardless of thread timing.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+
+from ..utils import metrics
+
+log = logging.getLogger(__name__)
+
+SITES = ("prefill", "chunk", "fetch", "batch", "grow", "*")
+KINDS = ("transient", "fatal", "hang", "oob")
+
+
+class TransientDeviceError(Exception):
+    """A dispatch failed in a way a retry can fix (flaky link, relay
+    hiccup).  The watchdog retries these with backoff."""
+
+
+class FatalDeviceError(Exception):
+    """The device (state) is lost; retrying the same dispatch cannot
+    succeed.  The supervisor checkpoints streams and rebuilds."""
+
+
+class DispatchTimeoutError(Exception):
+    """A dispatch exceeded ``DISPATCH_TIMEOUT_S``.  Classified fatal:
+    the dispatch thread may be wedged forever, so recovery means a
+    rebuild, not a retry against the same state."""
+
+
+def is_transient(exc: BaseException) -> bool:
+    return isinstance(exc, (TransientDeviceError, ConnectionError)) or bool(
+        getattr(exc, "transient", False)
+    )
+
+
+def is_fatal_device(exc: BaseException) -> bool:
+    return isinstance(exc, (FatalDeviceError, DispatchTimeoutError))
+
+
+class FaultRule:
+    """One parsed FAULT_SPEC rule with its own dispatch counter."""
+
+    __slots__ = ("site", "kind", "arg", "nth", "count", "rate", "seen", "fired")
+
+    def __init__(self, site: str, kind: str, arg: float,
+                 nth: int = 0, count: int = 1, rate: float = 0.0):
+        self.site = site
+        self.kind = kind
+        self.arg = arg
+        self.nth = nth
+        self.count = count
+        self.rate = rate
+        self.seen = 0
+        self.fired = 0
+
+    def __repr__(self) -> str:  # shows up in logs when a fault fires
+        trig = f"~{self.rate}" if self.rate else f"@{self.nth}+{self.count}"
+        return f"{self.site}:{self.kind}{trig}"
+
+
+_RULE_RE = re.compile(
+    r"^(?:(?P<site>[a-z*]+):)?"
+    r"(?P<kind>[a-z]+)"
+    r"(?:\((?P<arg>[0-9.]+)\))?"
+    r"(?:@(?P<nth>\d+)(?:\+(?P<count>\d+))?|~(?P<rate>[0-9.]+))$"
+)
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        m = _RULE_RE.match(part)
+        if m is None:
+            raise ValueError(f"unparseable FAULT_SPEC rule {part!r}")
+        site = m.group("site") or "*"
+        kind = m.group("kind")
+        if site not in SITES:
+            raise ValueError(
+                f"FAULT_SPEC site must be one of {SITES}, got {site!r}"
+            )
+        if kind not in KINDS:
+            raise ValueError(
+                f"FAULT_SPEC kind must be one of {KINDS}, got {kind!r}"
+            )
+        rate = float(m.group("rate") or 0.0)
+        if not (0.0 <= rate <= 1.0):
+            raise ValueError(f"FAULT_SPEC rate must be in [0, 1], got {rate}")
+        rules.append(FaultRule(
+            site, kind,
+            arg=float(m.group("arg") or 3600.0),
+            nth=int(m.group("nth") or 0),
+            count=int(m.group("count") or 1),
+            rate=rate,
+        ))
+    return rules
+
+
+class FaultInjector:
+    """Deterministic fault schedule over the dispatch sites.
+
+    Thread-safe: the trigger decision (counters + seeded RNG draw)
+    happens under a lock; the fault action (raise/sleep) happens
+    outside it so a hang never blocks other sites' bookkeeping."""
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0):
+        import random
+
+        self.rules = rules
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str | None, seed: int = 0) -> "FaultInjector | None":
+        if not spec:
+            return None
+        rules = parse_spec(spec)
+        return cls(rules, seed) if rules else None
+
+    def fire(self, site: str) -> None:
+        """Count one dispatch at ``site``; raise/sleep if a rule says
+        so.  Called at the TOP of each guarded dispatch attempt, so
+        watchdog retries re-roll the schedule like any real retry
+        would re-touch the device."""
+        hit = None
+        with self._lock:
+            for rule in self.rules:
+                if rule.site != "*" and rule.site != site:
+                    continue
+                rule.seen += 1
+                if rule.rate > 0.0:
+                    trigger = self._rng.random() < rule.rate
+                else:
+                    trigger = rule.nth <= rule.seen < rule.nth + rule.count
+                if trigger:
+                    rule.fired += 1
+                    hit = rule
+                    break
+        if hit is None:
+            return
+        log.warning("fault injected at %s: %r", site, hit)
+        if hit.kind == "transient":
+            raise TransientDeviceError(f"injected transient fault at {site}")
+        if hit.kind == "fatal":
+            raise FatalDeviceError(f"injected fatal device fault at {site}")
+        if hit.kind == "oob":
+            from .kv_blocks import OutOfBlocks
+
+            raise OutOfBlocks(f"injected OutOfBlocks at {site}")
+        # hang: sleep through the watchdog deadline (or, unsupervised,
+        # stall the caller for the full duration — the failure mode
+        # the watchdog exists to bound).
+        time.sleep(hit.arg)
+
+
+class Watchdog:
+    """Monitored-deadline + transient-retry wrapper for one dispatch.
+
+    ``run(site, fn)`` executes ``injector.fire(site)`` then ``fn()``;
+    with ``timeout_s > 0`` the attempt runs on a fresh daemon thread
+    and an overrun raises ``DispatchTimeoutError`` (the wedged thread
+    is abandoned — its eventual result is discarded, and the engine
+    rebuild replaces any state it touched).  Transient failures retry
+    up to ``retries`` times with capped exponential backoff."""
+
+    def __init__(self, model: str, timeout_s: float = 0.0, retries: int = 0,
+                 backoff_s: float = 0.05, injector: FaultInjector | None = None):
+        self.model = model
+        self.timeout_s = max(0.0, float(timeout_s))
+        self.retries = max(0, int(retries))
+        self.backoff_s = max(0.0, float(backoff_s))
+        self.injector = injector
+        self._passthrough = (
+            self.injector is None and self.timeout_s <= 0 and self.retries <= 0
+        )
+
+    def run(self, site: str, fn):
+        if self._passthrough:
+            return fn()
+        attempt = 0
+        while True:
+            try:
+                return self._attempt(site, fn)
+            except Exception as e:
+                if is_transient(e) and attempt < self.retries:
+                    metrics.DISPATCH_RETRIES.labels(
+                        self.model, type(e).__name__
+                    ).inc()
+                    time.sleep(min(self.backoff_s * (2 ** attempt), 2.0))
+                    attempt += 1
+                    continue
+                raise
+
+    def _attempt(self, site: str, fn):
+        def call():
+            if self.injector is not None:
+                self.injector.fire(site)
+            return fn()
+
+        if self.timeout_s <= 0:
+            return call()
+        box: dict = {}
+        done = threading.Event()
+
+        def worker():
+            try:
+                box["r"] = call()
+            except BaseException as e:
+                box["e"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=worker, daemon=True, name=f"dispatch-{site}"
+        )
+        t.start()
+        if not done.wait(self.timeout_s):
+            metrics.DISPATCH_TIMEOUTS.labels(self.model).inc()
+            raise DispatchTimeoutError(
+                f"{site} dispatch exceeded DISPATCH_TIMEOUT_S="
+                f"{self.timeout_s}s"
+            )
+        if "e" in box:
+            raise box["e"]
+        return box.get("r")

@@ -277,6 +277,21 @@ class ContinuousDecodeLoop:
         # per-stream path for oversized prompts) count against the same
         # MAX_STREAMS total; the Batcher wires this to its own counter.
         self.external_active = lambda: 0
+        # Crash recovery (engine/supervisor.py): when a Supervisor is
+        # attached (the Batcher does, SUPERVISE=1 default), a fatal
+        # dispatch fault or loop death checkpoints every live stream
+        # via the delivered-token cursor, rebuilds the device state
+        # (fresh KV pool, params re-placed, prefix cache flushed) and
+        # requeues the checkpoints for token-identical resume — up to
+        # the supervisor's restart budget.  None (direct construction,
+        # tests, SUPERVISE=0) keeps the historical error-every-stream
+        # behavior.
+        self.supervisor = None
+        # A fatal fault detected off the loop's main try (e.g. during
+        # a prefill whose streams were checkpoint-requeued in place):
+        # raised at the next iteration top so the shared recovery path
+        # runs with clean pending lists.
+        self._fault_pending: BaseException | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._thread_lock = threading.Lock()
@@ -324,6 +339,17 @@ class ContinuousDecodeLoop:
 
         if self._stop.is_set():
             raise RuntimeError("decode loop is stopped")
+        if (
+            float(feats.get("temperature", 0.0) or 0.0) > 0.0
+            and feats.get("seed") is None
+        ):
+            # Pin the sampling seed at admission: any checkpoint resume
+            # (preemption, crash recovery) REPLAYS the generation, and
+            # an unseeded row would draw a fresh seed at re-collate —
+            # the replay would diverge from tokens already delivered.
+            import random
+
+            feats["seed"] = random.getrandbits(32)
         adm = self.admission
         st = _Stream(
             feats, asyncio.get_running_loop(), self.engine.budget_for(feats)
@@ -450,9 +476,49 @@ class ContinuousDecodeLoop:
             self._release(st)
 
     def _run(self) -> None:
+        """Thread entry: the iteration loop, plus last-resort cleanup.
+        If the loop body ever dies on something its per-iteration
+        handler cannot catch (BaseException), every consumer still
+        gets a terminal error instead of hanging forever — a dead
+        loop thread must never strand its clients."""
+        try:
+            self._run_loop()
+        except BaseException as e:  # pragma: no cover - defensive
+            log.exception("decode loop thread died")
+            self._abort_all(e)
+            raise
+
+    def _abort_all(self, exc: BaseException) -> None:
+        """Terminal error to every queued, pending and active stream."""
+        for st, *_ in self._pending_admissions:
+            self._finish(st, exc)
+        self._pending_admissions = []
+        for st in self._pending_wave:
+            self._finish(st, exc)
+        self._pending_wave = []
+        for st in self.queue.drain_all():
+            self._finish(st, exc)
+        for slot in list(self.active):
+            st = self.active.get(slot)
+            if st is not None:
+                st.emit(exc)
+            self._free_slot(slot)
+        self._inflight_chunks.clear()
+        # A revived thread (next submission) must never reuse a state
+        # the dead one may have left half-mutated.
+        self._state = None
+        self.sampled_slots.clear()
+
+    def _run_loop(self) -> None:
         log.info("continuous decode loop up: %d slots", self.n_slots)
         while not self._stop.is_set():
             try:
+                # A fatal fault parked by the prefill path (its streams
+                # already checkpoint-requeued): run the shared recovery
+                # now, with clean pending lists.
+                if self._fault_pending is not None:
+                    e, self._fault_pending = self._fault_pending, None
+                    raise e
                 # Stale waiters shed as fast 504s BEFORE any admission
                 # work — never prefill a request nobody is waiting for.
                 self._expire_queued()
@@ -516,7 +582,9 @@ class ContinuousDecodeLoop:
                     # Round-3 blocking order, kept for A/B
                     # (ADMIT_OVERLAP=0): prefill + fetch + insert all
                     # before the next chunk dispatch.
+                    self._pending_wave = wave
                     self._pending_admissions = self._admit_dispatch(wave)
+                    self._pending_wave = []
                     self._admit_complete(self._pending_admissions)
                     self._pending_admissions = []
                     wave = []
@@ -561,24 +629,40 @@ class ContinuousDecodeLoop:
                     # Waiters exist but none fit the KV budget (no
                     # admission, no work in flight): poll, don't spin.
                     time.sleep(0.01)
-            except Exception as e:  # pragma: no cover - defensive
+            except Exception as e:
+                if self._recover(e):
+                    continue
                 log.exception("decode loop iteration failed")
+                n_lost = 0
                 for st, *_ in self._pending_admissions:
                     self._finish(st, e)
+                    n_lost += 1
                 self._pending_admissions = []
                 for st in self._pending_wave:
                     self._finish(st, e)
+                    n_lost += 1
                 self._pending_wave = []
                 for slot in list(self.active):
                     st = self.active.get(slot)
                     if st is not None:
                         st.emit(e)
+                        n_lost += 1
                     self._free_slot(slot)
+                if n_lost:
+                    metrics.STREAMS_LOST.labels(
+                        self.engine.bundle.name
+                    ).inc(n_lost)
                 # A failed dispatch may have already consumed (donated)
                 # the state buffers — rebuild lazily on next admission.
                 self._state = None
                 self._inflight_chunks.clear()
                 self.sampled_slots.clear()
+                # Restart budget exhausted: the engine is declared
+                # broken — stop the loop (the shutdown path below ends
+                # every queued consumer) and leave /readyz permanently
+                # unready via the supervisor's ``failed`` flag.
+                if self.supervisor is not None and self.supervisor.failed:
+                    self._stop.set()
         # Shutdown: end every remaining consumer cleanly.
         for st in self.queue.drain_all():
             self._finish(st, StreamClosedError("server stopping"))
@@ -599,6 +683,97 @@ class ContinuousDecodeLoop:
             self._finish(st, DeadlineExceededError(
                 "deadline passed while queued; stream shed before dispatch"
             ))
+
+    # -- crash recovery ------------------------------------------------
+
+    def _checkpoint_requeue(self, st: _Stream) -> bool:
+        """Checkpoint one stream (delivered-token cursor) and requeue
+        it through admission for token-identical resume; finished or
+        cancelled streams just end.  Returns True when requeued."""
+        if self.admission is not None:
+            self.admission.release(st)
+        if st.cancelled.is_set() or st.budget - st.produced <= 0:
+            self._finish(st)
+            return False
+        self._requeue_preempted(st)
+        return True
+
+    def _fail_streams(self, streams: list[_Stream], exc: Exception) -> None:
+        """Prefill-path failure delivery for ``streams`` (not yet in a
+        slot).  A fatal DEVICE fault under a supervisor checkpoints
+        and requeues them — nothing was delivered yet, so resume is a
+        clean, token-identical restart — and arms an engine rebuild
+        for the next iteration.  Anything else (a poisoned request, a
+        per-wave shape bug) error-terminates just these consumers, so
+        one bad request can never take the loop down."""
+        from .faults import is_fatal_device
+
+        if self.supervisor is not None and is_fatal_device(exc):
+            for st in streams:
+                self._checkpoint_requeue(st)
+            self._fault_pending = exc
+            return
+        for st in streams:
+            self._finish(st, exc)
+
+    def _recover(self, exc: Exception) -> bool:
+        """Supervised crash recovery, on the loop thread: checkpoint
+        every pending and active stream via the delivered-token cursor
+        (``produced`` advances only at delivery, so in-flight chunks
+        that were never fetched are simply not part of any checkpoint
+        — no token is ever dropped or re-sent), tear down and rebuild
+        the device state, and requeue the checkpoints through
+        admission.  Returns False — caller error-terminates everything
+        — when no supervisor is attached or the restart budget is
+        spent."""
+        sup = self.supervisor
+        if sup is None or not sup.allow_restart():
+            return False
+        eng = self.engine
+        log.warning(
+            "decode loop fault (%s: %s); supervised engine restart %d/%d",
+            type(exc).__name__, exc, sup.restarts, sup.max_restarts,
+        )
+        recovered = 0
+        for st, *_ in self._pending_admissions:
+            recovered += self._checkpoint_requeue(st)
+        self._pending_admissions = []
+        for st in self._pending_wave:
+            recovered += self._checkpoint_requeue(st)
+        self._pending_wave = []
+        for slot in list(self.active):
+            st = self.active.pop(slot)
+            if self.paged and st.blocks is not None:
+                # Deref into the OLD pool (discarded below) so the
+                # StreamBlocks object can't double-free later.
+                st.blocks.release()
+                st.blocks = None
+            recovered += self._checkpoint_requeue(st)
+        self.sampled_slots.clear()
+        self.free = list(range(self.n_slots))
+        self._inflight_chunks.clear()
+        self._state = None
+        # Device-side rebuild: fresh KV pool, params re-placed, prefix
+        # cache flushed (compiled executables survive — the process is
+        # alive — so the rebuilt engine is warm).
+        eng.reset_device_state()
+        if self.paged:
+            self.pool = eng.kv_pool
+            self._table = np.full(
+                (self.n_slots, self.nb_max), self.pool.num_blocks, np.int32
+            )
+            self._dispatched_steps.clear()
+        if self.admission is not None:
+            self.admission.pool = eng.kv_pool
+            self.admission.note_pool()
+        metrics.ENGINE_RESTARTS.labels(eng.bundle.name).inc()
+        if recovered:
+            metrics.STREAMS_RECOVERED.labels(eng.bundle.name).inc(recovered)
+        log.info(
+            "engine rebuilt; %d stream checkpoint(s) requeued for "
+            "token-identical resume", recovered,
+        )
+        return True
 
     # -- preemption ----------------------------------------------------
 
@@ -776,9 +951,11 @@ class ContinuousDecodeLoop:
                         # own bucket (through the prefix cache when
                         # on) — TTFT = solo serving; the slot insert
                         # pads narrower states up to the slot shapes.
-                        state1, toks, sampled = eng.start_fused(st.feats)
+                        state1, toks, sampled = eng.dispatch_guard(
+                            "prefill", lambda: eng.start_fused(st.feats)
+                        )
                     except Exception as e:
-                        self._finish(st, e)
+                        self._fail_streams([st], e)
                         continue
                     if self.paged:
                         from .engine import bucket_for
@@ -807,13 +984,15 @@ class ContinuousDecodeLoop:
                 ids, mask, _ = eng._collate_text(feats_list)
                 sp, sampled = eng._collate_sample(feats_list, ids.shape[0])
                 ids, mask = eng.replicas.place_batch(ids, mask)
-                state1, toks = eng._start(
-                    eng.params, ids, mask, sp,
-                    eng.max_decode_len, eng.chunk_tokens, sampled,
+                state1, toks = eng.dispatch_guard(
+                    "prefill",
+                    lambda: eng._start(
+                        eng.params, ids, mask, sp,
+                        eng.max_decode_len, eng.chunk_tokens, sampled,
+                    ),
                 )
             except Exception as e:
-                for st in ok:
-                    self._finish(st, e)
+                self._fail_streams(ok, e)
                 return started
             self.prefill_dispatches += 1
             prefetch_to_host(toks, state1.done)
@@ -921,13 +1100,15 @@ class ContinuousDecodeLoop:
                 ids, mask, sp, sampled = collate_place(
                     pad_feats([st.feats for st, _, _ in misses])
                 )
-                state1, toks = eng._start(
-                    eng.params, ids, mask, sp,
-                    eng.max_decode_len, eng.chunk_tokens, sampled,
+                state1, toks = eng.dispatch_guard(
+                    "prefill",
+                    lambda: eng._start(
+                        eng.params, ids, mask, sp,
+                        eng.max_decode_len, eng.chunk_tokens, sampled,
+                    ),
                 )
             except Exception as e:
-                for st, _, _ in misses:
-                    self._finish(st, e)
+                self._fail_streams([st for st, _, _ in misses], e)
             else:
                 for row, (st, row_ids, L) in enumerate(misses):
                     if self.paged:
@@ -949,21 +1130,23 @@ class ContinuousDecodeLoop:
                 ids, mask, sp, sampled = collate_place(
                     pad_feats(suffix_feats)
                 )
-                if len(members) == 1:
-                    state1, toks = eng._start_prefixed(
-                        eng.params, members[0][4], ids, mask, sp,
-                        eng.max_decode_len, eng.chunk_tokens, sampled,
-                    )
-                else:
+
+                def start_hits():
+                    if len(members) == 1:
+                        return eng._start_prefixed(
+                            eng.params, members[0][4], ids, mask, sp,
+                            eng.max_decode_len, eng.chunk_tokens, sampled,
+                        )
                     pkvs = tuple(pkv for _, _, _, _, pkv in members)
                     pkvs = pkvs + (pkvs[0],) * (ids.shape[0] - len(pkvs))
-                    state1, toks = eng._start_prefixed_wave(
+                    return eng._start_prefixed_wave(
                         eng.params, pkvs, ids, mask, sp,
                         eng.max_decode_len, eng.chunk_tokens, sampled,
                     )
+
+                state1, toks = eng.dispatch_guard("prefill", start_hits)
             except Exception as e:
-                for st, *_ in members:
-                    self._finish(st, e)
+                self._fail_streams([st for st, *_ in members], e)
                 continue
             for row, (st, row_ids, L, pl, _) in enumerate(members):
                 if self.paged:
@@ -991,11 +1174,14 @@ class ContinuousDecodeLoop:
         with eng._lock:
             try:
                 fetched = dict(zip(
-                    uniq.keys(), jax.device_get(list(uniq.values()))
+                    uniq.keys(),
+                    eng.dispatch_guard(
+                        "fetch",
+                        lambda: jax.device_get(list(uniq.values())),
+                    ),
                 ))
             except Exception as e:
-                for st, *_ in started:
-                    self._finish(st, e)
+                self._fail_streams([st for st, *_ in started], e)
                 return
         for st, state1, toks, sampled, row, ids, mask in started:
             toks_np, done_np = fetched[id(toks)]
@@ -1391,6 +1577,7 @@ class ContinuousDecodeLoop:
         s_cut = st.s_base + eng.chunk_tokens
         sb = StreamBlocks(self.pool, self.block_size)
         try:
+            eng.fault_point("grow")
             if st.shared_ids:
                 sb.adopt(st.shared_ids)
             self._reclaim_then_ensure(sb, s_cut)
@@ -1506,6 +1693,9 @@ class ContinuousDecodeLoop:
             # delivery); don't spend blocks on them.
             need = min(st.s_base + steps, st.s_base + st.budget)
             try:
+                # Fault-injection point: a forced OutOfBlocks here
+                # exercises the reclaim → checkpoint-and-requeue path.
+                eng.fault_point("grow")
                 fresh = st.blocks.ensure(need)
             except OutOfBlocks:
                 try:
@@ -1539,9 +1729,13 @@ class ContinuousDecodeLoop:
             import jax.numpy as jnp
 
             with eng._lock:
-                self._state, toks = self._paged_chunk_fn()(
-                    eng.params, self._state, jnp.asarray(self._table),
-                    eng.chunk_tokens, use_sample,
+                table = jnp.asarray(self._table)
+                self._state, toks = eng.dispatch_guard(
+                    "chunk",
+                    lambda: self._paged_chunk_fn()(
+                        eng.params, self._state, table,
+                        eng.chunk_tokens, use_sample,
+                    ),
                 )
                 done = self._state.done
                 prefetch_to_host(toks, done)
@@ -1556,16 +1750,23 @@ class ContinuousDecodeLoop:
             if self.spec:
                 # One batched draft→verify chunk: every live row emits
                 # chunk_tokens..chunk_tokens·(spec_k+1) tokens.
-                self._state, out, ns = eng._spec_chunk(
-                    eng.params, self._state, eng.chunk_tokens,
-                    eng.spec_k, use_sample,
+                self._state, out, ns = eng.dispatch_guard(
+                    "chunk",
+                    lambda: eng._spec_chunk(
+                        eng.params, self._state, eng.chunk_tokens,
+                        eng.spec_k, use_sample,
+                    ),
                 )
                 toks = (out, ns)
                 done = self._state.base.done
                 prefetch_to_host(out, ns, done)
             else:
-                self._state, toks = eng._gen_chunk(
-                    eng.params, self._state, eng.chunk_tokens, use_sample
+                self._state, toks = eng.dispatch_guard(
+                    "chunk",
+                    lambda: eng._gen_chunk(
+                        eng.params, self._state, eng.chunk_tokens,
+                        use_sample,
+                    ),
                 )
                 done = self._state.done
                 # Start the host copies now so the fetch in
@@ -1582,7 +1783,9 @@ class ContinuousDecodeLoop:
         if not self._inflight_chunks:
             return
         toks, done, snapshot = self._inflight_chunks.pop(0)
-        toks_np, done_np = jax.device_get((toks, done))
+        toks_np, done_np = self.engine.dispatch_guard(
+            "fetch", lambda: jax.device_get((toks, done))
+        )
         self._route_chunk(toks_np, done_np, snapshot)
 
     def _deliver_all(self) -> None:
@@ -1593,7 +1796,10 @@ class ContinuousDecodeLoop:
             return
         entries = self._inflight_chunks
         self._inflight_chunks = []
-        fetched = jax.device_get([(t, d) for t, d, _ in entries])
+        fetched = self.engine.dispatch_guard(
+            "fetch",
+            lambda: jax.device_get([(t, d) for t, d, _ in entries]),
+        )
         for (_, _, snapshot), (toks_np, done_np) in zip(entries, fetched):
             self._route_chunk(toks_np, done_np, snapshot)
 

@@ -1,0 +1,48 @@
+"""Engine supervisor: the restart-budget half of crash recovery.
+
+The ContinuousDecodeLoop detects faults (watchdog timeouts, fatal
+device errors, unexpected loop exceptions) on its own thread and owns
+the recovery mechanics — checkpoint every live stream via the
+delivered-token cursor, rebuild the device state, requeue the
+checkpoints for token-identical resume (``streams._recover``).  This
+object holds the POLICY: how many rebuilds a process may spend before
+it declares itself broken.
+
+Once ``failed`` flips, the loop stops, every remaining consumer gets
+a terminal error, new submissions are refused, and ``/readyz`` goes
+permanently unready — a crash-looping engine must fall out of the
+load balancer instead of flapping."""
+
+from __future__ import annotations
+
+import threading
+
+
+class Supervisor:
+    """Bounded-restart policy shared by the decode loop and /readyz."""
+
+    def __init__(self, cfg=None, max_restarts: int | None = None):
+        if max_restarts is None:
+            max_restarts = int(getattr(cfg, "engine_restarts_max", 3) or 0)
+        self.max_restarts = max(0, int(max_restarts))
+        self.restarts = 0
+        self.failed = False
+        self._lock = threading.Lock()
+
+    def allow_restart(self) -> bool:
+        """Spend one restart from the budget; False (and ``failed``)
+        once it is exhausted."""
+        with self._lock:
+            if self.failed or self.restarts >= self.max_restarts:
+                self.failed = True
+                return False
+            self.restarts += 1
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "restarts": self.restarts,
+                "max_restarts": self.max_restarts,
+                "failed": self.failed,
+            }

@@ -123,6 +123,10 @@ class Batcher:
         # each (engine/streams.py).  CONTINUOUS_BATCHING=0 falls back to
         # the per-stream path above (kept for A/B measurement).
         self._cdl = None
+        # Supervised crash recovery (engine/supervisor.py): bounded
+        # engine rebuilds on fatal dispatch faults.  /readyz reads the
+        # ``failed`` flag once the restart budget is spent.
+        self.supervisor = None
         if getattr(engine.bundle, "kind", None) == "seq2seq" and getattr(
             cfg, "continuous_batching", True
         ):
@@ -134,6 +138,11 @@ class Batcher:
             self._cdl.external_active = lambda: self._active_streams
             # One admission controller (and KV ledger) for both queues.
             self._cdl.admission = self.admission
+            if getattr(cfg, "supervise", True):
+                from ..engine.supervisor import Supervisor
+
+                self.supervisor = Supervisor(cfg)
+                self._cdl.supervisor = self.supervisor
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -497,9 +506,23 @@ class Batcher:
         metrics.BATCH_SIZE.labels(self.model).observe(len(batch))
         t0 = time.monotonic()
         try:
-            rows = await loop.run_in_executor(
-                self._executor, self.engine.run_batch, feats
-            )
+            # The batch path's dispatch boundary runs under the same
+            # fault injector + watchdog as the decode loop's chunks:
+            # transients retry with backoff, a hang is cut off at
+            # DISPATCH_TIMEOUT_S instead of wedging a worker forever.
+            # (Duck-typed engines without a guard dispatch bare.)
+            guard = getattr(self.engine, "dispatch_guard", None)
+            if guard is None:
+                rows = await loop.run_in_executor(
+                    self._executor, self.engine.run_batch, feats
+                )
+            else:
+                rows = await loop.run_in_executor(
+                    self._executor,
+                    lambda: guard(
+                        "batch", lambda: self.engine.run_batch(feats)
+                    ),
+                )
         except Exception as e:
             for item in batch:
                 item.fail(e)

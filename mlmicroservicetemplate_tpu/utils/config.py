@@ -218,6 +218,30 @@ class ServiceConfig(BaseModel):
     # Seconds the SIGTERM drain waits for in-flight work before exit.
     drain_grace_s: float = 30.0
 
+    # Fault tolerance (engine/faults.py + engine/supervisor.py).
+    # Deterministic fault-injection schedule wrapped around the
+    # device-dispatch boundaries; off (None) = zero overhead.  Grammar
+    # in engine/faults.py, e.g. "chunk:fatal@5;*:transient~0.05".
+    fault_spec: str | None = None
+    # Seed for rate-based (~) fault rules, so a chaos run replays.
+    fault_seed: int = 0
+    # Watchdog deadline per device dispatch in seconds; an overrun
+    # raises DispatchTimeoutError (classified fatal → supervisor
+    # rebuild) instead of stalling the decode loop forever.  0 = off
+    # (the seed behavior; supervised deployments should set e.g. 60).
+    dispatch_timeout_s: float = 0.0
+    # Transient dispatch failures retried with capped exponential
+    # backoff before the error escalates.
+    dispatch_retries: int = 2
+    dispatch_backoff_s: float = 0.05
+    # Engine rebuilds the supervisor may spend (fatal fault / loop
+    # death → checkpoint streams, rebuild device state, resume) before
+    # /readyz goes permanently unready.
+    engine_restarts_max: int = 3
+    # Supervised crash recovery for the continuous decode loop; off
+    # restores the seed's error-every-stream behavior on a fault.
+    supervise: bool = True
+
     # Observability.
     log_level: str = "INFO"
 
@@ -309,6 +333,30 @@ class ServiceConfig(BaseModel):
             raise ValueError("KV_BLOCK_SIZE must be in [1, 1024]")
         return v
 
+    @field_validator("fault_spec")
+    @classmethod
+    def _check_fault_spec(cls, v: str | None) -> str | None:
+        # Grammar validation happens at engine construction (still
+        # startup, before readiness) — engine/faults.py cannot be
+        # imported here because this module must stay jax-free.
+        if v is not None and v.strip().lower() in ("", "none", "off", "0"):
+            return None
+        return v
+
+    @field_validator("dispatch_timeout_s", "dispatch_backoff_s")
+    @classmethod
+    def _check_nonneg_float(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("dispatch timeout/backoff must be >= 0")
+        return v
+
+    @field_validator("dispatch_retries", "engine_restarts_max")
+    @classmethod
+    def _check_nonneg_int(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError("DISPATCH_RETRIES/ENGINE_RESTARTS_MAX must be >= 0")
+        return v
+
 
 def _env(name: str, default: str | None = None) -> str | None:
     v = os.environ.get(name)
@@ -326,7 +374,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX,
       SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
-      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE.
+      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, FAULT_SPEC, FAULT_SEED,
+      DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
+      ENGINE_RESTARTS_MAX, SUPERVISE.
     """
     e = dict(os.environ)
     if env:
@@ -350,6 +400,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "prompt_prefix": "PROMPT_PREFIX",
         "spec_decode": "SPEC_DECODE",
         "priority_default": "PRIORITY_DEFAULT",
+        "fault_spec": "FAULT_SPEC",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -372,6 +423,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "class_weight": "CLASS_WEIGHT",
         "max_stream_queue": "MAX_STREAM_QUEUE",
         "kv_block_size": "KV_BLOCK_SIZE",
+        "fault_seed": "FAULT_SEED",
+        "dispatch_retries": "DISPATCH_RETRIES",
+        "engine_restarts_max": "ENGINE_RESTARTS_MAX",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -387,6 +441,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("deadline_ms", "DEADLINE_MS"),
         ("kv_budget_mb", "KV_BUDGET_MB"),
         ("drain_grace_s", "DRAIN_GRACE_S"),
+        ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
+        ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),
     ):
         v = get(var)
         if v is not None:
@@ -397,6 +453,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PAGED_KV")
     if v is not None:
         kwargs["paged_kv"] = v.lower() not in ("0", "false", "no")
+    v = get("SUPERVISE")
+    if v is not None:
+        kwargs["supervise"] = v.lower() not in ("0", "false", "no")
     # Comma-separated bucket overrides, e.g. BATCH_BUCKETS=1,8,32 — used
     # to bound warmup compile time when only some shapes will be served.
     for field, var in (("batch_buckets", "BATCH_BUCKETS"), ("seq_buckets", "SEQ_BUCKETS")):

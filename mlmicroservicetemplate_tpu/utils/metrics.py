@@ -122,6 +122,34 @@ KV_POOL_BLOCKS = Gauge(
     "Paged-KV pool blocks by state (used includes prefix-cache pins)",
     ["model", "state"],
 )
+ENGINE_RESTARTS = Counter(
+    "engine_restarts_total",
+    "Supervised engine rebuilds after a fatal dispatch fault or decode "
+    "loop death (streams checkpoint and resume token-identically)",
+    ["model"],
+)
+DISPATCH_RETRIES = Counter(
+    "dispatch_retries_total",
+    "Transient dispatch failures retried under the watchdog, by "
+    "exception type",
+    ["model", "reason"],
+)
+DISPATCH_TIMEOUTS = Counter(
+    "dispatch_timeouts_total",
+    "Dispatches cut off by the DISPATCH_TIMEOUT_S watchdog deadline",
+    ["model"],
+)
+STREAMS_RECOVERED = Counter(
+    "streams_recovered_total",
+    "Live streams checkpointed and requeued across an engine rebuild",
+    ["model"],
+)
+STREAMS_LOST = Counter(
+    "streams_lost_total",
+    "Live streams error-terminated by an unrecoverable engine fault "
+    "(no supervisor, or the restart budget was exhausted)",
+    ["model"],
+)
 KV_GROWTH_STALLS = Counter(
     "kv_growth_stalls_total",
     "Paged-KV decode growth found the pool dry: the stream was "

@@ -30,6 +30,26 @@ if [ "$1" = "--fast" ]; then
     exit 0
 fi
 
+# Chaos tier: the fault-injection/recovery suite (kept OUT of tier-1 by
+# the conftest's chaos->slow propagation) plus a 3-point FAULT_SPEC
+# smoke matrix — one transient, one fatal, one watchdog-cut hang — each
+# run against the supervised loop expecting token-identical completion.
+# CHAOS=0 skips the stage.
+if [ "${CHAOS:-1}" != "0" ]; then
+    echo "== chaos suite (fault injection + crash recovery) =="
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+    echo "== FAULT_SPEC smoke matrix =="
+    for spec in "chunk:transient@2" "chunk:fatal@2" "chunk:hang(30)@2"; do
+        echo "-- FAULT_SMOKE_SPEC=$spec"
+        timeout -k 10 240 env JAX_PLATFORMS=cpu FAULT_SMOKE_SPEC="$spec" \
+            python -m pytest tests/test_faults.py::test_fault_spec_smoke -q \
+            -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+    done
+else
+    echo "== chaos suite skipped (CHAOS=0) =="
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

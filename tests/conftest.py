@@ -75,6 +75,12 @@ def pytest_configure(config):
         "mid: measured 3-12s (subset of slow; pytest -m mid, <10 min "
         "total; the heavy remainder is -m 'slow and not mid')",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / crash-recovery suite (pytest -m "
+        "chaos; also marked slow so tier-1's -m 'not slow' never runs "
+        "it — scripts/check.sh has the chaos stage)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -87,6 +93,11 @@ def pytest_collection_modifyitems(config, items):
     except Exception:
         durations = {}
     for item in items:
+        # Chaos tests live in their own tier: always slow (kept out of
+        # tier-1), never quick, regardless of measured duration.
+        if item.get_closest_marker("chaos") is not None:
+            item.add_marker(pytest.mark.slow)
+            continue
         # Node ids in the file are relative to the repo root
         # ("tests/test_x.py::test_y").
         nid = item.nodeid
