@@ -1,0 +1,187 @@
+"""Prefill-interference A/B: chunked prefill (PREFILL_CHUNK) vs the
+monolithic seed under the head-of-line shape it exists for.
+
+The shape (ISSUE 5): a few short interactive streams are decoding
+through the continuous loop when one LONG prompt arrives.  Monolithic
+prefill dispatches that prompt as one fused forward in front of the
+next decode chunk, so every live stream's time-between-tokens (TBT)
+spikes by the whole prefill; chunked prefill interleaves
+PREFILL_CHUNK-token windows between decode chunks, bounding the spike
+to one window's compute.
+
+Two arms over the SAME service (gpt2 124M random-init, streaming):
+
+- **mono**: ``PREFILL_CHUNK=0`` — the seed's monolithic prefill.
+- **chunk<N>**: ``PREFILL_CHUNK=N`` for each N in ``PREFILL_AB_CHUNKS``
+  (the sweep that picks the documented default).
+
+Reported per (arm, repeat-aggregated): decode **TBT p99 and max** over
+the short streams' inter-chunk gaps while the long prompt is in
+flight (the judged stall), the long prompt's TTFT, and the short
+streams' TTFT.  The acceptance claim: the chunked arm strictly lowers
+short-stream TBT p99/max; the honest cost is the long prompt's own
+TTFT (its windows yield to decode — that is the policy working).
+
+    python benchmarks/prefill_interference_ab.py            # current backend
+    DEVICE=cpu python benchmarks/prefill_interference_ab.py # CPU sanity run
+
+One JSON line per row to stdout, a markdown table to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+from harness import ServiceUnderTest, pctile  # noqa: E402
+
+# The service byte-tokenizes gpt2 text, so prompt length == byte count.
+SHORT_PROMPT = "the quick brown fox jumps over "  # 31 tokens < every chunk
+LONG_LEN = int(os.environ.get("PREFILL_AB_LONG", "448"))
+N_SHORT = 3
+SHORT_TOKENS = 48  # decode budget: keeps shorts live across the prefill
+CHUNKS = tuple(
+    int(c)
+    for c in os.environ.get("PREFILL_AB_CHUNKS", "32,64,128").split(",")
+    if c.strip()
+)
+REPEATS = int(os.environ.get("PREFILL_AB_REPEATS", "3"))
+
+
+async def _short_stream(client, t_gate: asyncio.Event, out: dict):
+    """One short interactive stream; records its TTFT and the
+    timestamp of every chunk event so gaps can be sliced against the
+    long prompt's in-flight window afterwards."""
+    t0 = time.perf_counter()
+    resp = await client.post(
+        "/predict",
+        json={"text": SHORT_PROMPT, "stream": True,
+              "max_tokens": SHORT_TOKENS},
+        headers={"X-Priority": "interactive"},
+    )
+    assert resp.status == 200, await resp.text()
+    stamps = []
+    async for line in resp.content:
+        stamps.append(time.perf_counter())
+        if not t_gate.is_set():
+            t_gate.set()  # first token anywhere arms the long prompt
+        if json.loads(line).get("done"):
+            break
+    out.setdefault("ttft", []).append(stamps[0] - t0)
+    out.setdefault("stamps", []).append(stamps)
+
+
+async def _long_stream(client, t_gate: asyncio.Event, out: dict):
+    """The interfering long prompt: fires once a short stream is
+    decoding, records TTFT and its own in-flight window."""
+    await t_gate.wait()
+    t0 = time.perf_counter()
+    out["t_launch"] = t0
+    resp = await client.post(
+        "/predict",
+        json={"text": "x" * LONG_LEN, "stream": True, "max_tokens": 8},
+        headers={"X-Priority": "batch"},
+    )
+    assert resp.status == 200, await resp.text()
+    first = None
+    async for line in resp.content:
+        if first is None:
+            first = time.perf_counter()
+        if json.loads(line).get("done"):
+            break
+    out["ttft"] = (first if first is not None else time.perf_counter()) - t0
+    out["t_done"] = time.perf_counter()
+
+
+async def run_arm(arm: str, prefill_chunk: int, dev: dict, rows: list):
+    overrides = {
+        "MODEL_NAME": "gpt2",
+        "BATCH_BUCKETS": "1,4",
+        # Max bucket covers the long prompt: BOTH arms admit it through
+        # the continuous loop, so the A/B isolates the dispatch shape
+        # (monolithic vs windowed), not the round-8 routing-bug class.
+        "SEQ_BUCKETS": "64,512",
+        "MAX_DECODE_LEN": str(SHORT_TOKENS),
+        "MAX_STREAMS": "4",
+        **({"PREFILL_CHUNK": str(prefill_chunk)} if prefill_chunk else {}),
+        **dev,
+    }
+    tbt_gaps: list[float] = []
+    tbt_all_gaps: list[float] = []
+    short_ttfts: list[float] = []
+    long_ttfts: list[float] = []
+    async with ServiceUnderTest(overrides) as s:
+        # Discard one warm probe (lazy one-time costs).
+        gate0: asyncio.Event = asyncio.Event()
+        await _short_stream(s.client, gate0, {})
+        for _ in range(REPEATS):
+            gate: asyncio.Event = asyncio.Event()
+            shorts: dict = {}
+            longd: dict = {}
+            await asyncio.gather(
+                *(_short_stream(s.client, gate, shorts)
+                  for _ in range(N_SHORT)),
+                _long_stream(s.client, gate, longd),
+            )
+            short_ttfts.extend(shorts["ttft"])
+            long_ttfts.append(longd["ttft"])
+            # The judged stall: short-stream inter-chunk gaps that END
+            # inside the long prompt's in-flight window (launch →
+            # done).  A monolithic prefill parks the loop thread, so
+            # one of these gaps swallows the whole prefill.
+            for stamps in shorts["stamps"]:
+                for a, b in zip(stamps, stamps[1:]):
+                    gap = b - a
+                    tbt_all_gaps.append(gap)
+                    if longd["t_launch"] <= b <= longd["t_done"]:
+                        tbt_gaps.append(gap)
+            await asyncio.sleep(0.5)  # drain the slot pool between reps
+    rows.append({
+        "arm": arm,
+        "tbt_p99_ms": round(pctile(tbt_gaps, 0.99) * 1e3, 1)
+        if tbt_gaps else None,
+        "tbt_max_ms": round(max(tbt_gaps) * 1e3, 1) if tbt_gaps else None,
+        "tbt_all_p99_ms": round(pctile(tbt_all_gaps, 0.99) * 1e3, 1),
+        "gaps_in_window": len(tbt_gaps),
+        "long_ttft_ms": round(
+            sorted(long_ttfts)[len(long_ttfts) // 2] * 1e3, 1
+        ),
+        "short_ttft_p50_ms": round(
+            sorted(short_ttfts)[len(short_ttfts) // 2] * 1e3, 1
+        ),
+        "long_len": LONG_LEN,
+        "short_streams": N_SHORT,
+    })
+
+
+async def main() -> None:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    rows: list = []
+    await run_arm("mono", 0, dev, rows)
+    for c in CHUNKS:
+        await run_arm(f"chunk{c}", c, dev, rows)
+
+    import jax
+
+    backend = jax.default_backend()
+    print("\n| arm | tbt p99 (ms) | tbt max (ms) | long ttft (ms) "
+          "| short ttft p50 (ms) | gaps |", file=sys.stderr)
+    print("|---|---|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(
+            f"| {r['arm']} | {r['tbt_p99_ms']} | {r['tbt_max_ms']} "
+            f"| {r['long_ttft_ms']} | {r['short_ttft_p50_ms']} "
+            f"| {r['gaps_in_window']} |",
+            file=sys.stderr,
+        )
+        print(json.dumps({**r, "backend": backend}))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

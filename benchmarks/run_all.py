@@ -141,6 +141,15 @@ async def main() -> None:
             check=False,
         )
 
+    # Chunked prefill (round-10 tentpole): decode TBT p99 under the
+    # long-prompt interference shape, monolithic seed vs a
+    # PREFILL_CHUNK sweep.  PREFILL_AB=0 skips.
+    if os.environ.get("PREFILL_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "prefill_interference_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

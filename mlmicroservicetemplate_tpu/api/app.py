@@ -568,7 +568,12 @@ async def _open_stream(request: web.Request, feats: dict, item: RawItem,
         # _delta_stream always yields a final event; defensive.
         metrics.REQUESTS.labels(bundle.name, "500").inc()
         raise _internal_error(request, "stream produced no events")
-    metrics.TTFT.labels(bundle.name).observe(time.monotonic() - t0)
+    # Admission-mode label: the continuous loop stamps "chunked" on the
+    # feats dict it was handed when PREFILL_CHUNK routed this prompt to
+    # windowed prefill; everything else is a monolithic prefill.
+    metrics.TTFT.labels(
+        bundle.name, feats.get("prefill_mode", "monolithic")
+    ).observe(time.monotonic() - t0)
 
     async def chained():
         yield first
@@ -1096,6 +1101,16 @@ async def handle_status(request: web.Request) -> web.Response:
     }
     if batcher.supervisor is not None:
         body["fault_tolerance"] = batcher.supervisor.stats()
+    cdl = getattr(batcher, "_cdl", None)
+    if cdl is not None and getattr(cdl, "prefill_chunk", 0):
+        body["prefill"] = {
+            "chunk": cdl.prefill_chunk,
+            "budget": cdl.prefill_budget,
+            "max_prompt": cdl.max_prompt,
+            "chunks_total": cdl.prefill_chunk_dispatches,
+            "backlog_tokens": cdl.prefill_backlog_tokens(),
+            "stall_seconds": round(cdl.prefill_stall_s, 4),
+        }
     err = app[K_STATE]["ready_error"]
     if err:
         body["ready_error"] = err

@@ -261,6 +261,36 @@ class InferenceEngine:
                     num = max(1, int(getattr(cfg, "max_streams", 8))) * worst
                 self.kv_pool = BlockPool(num, bb)
 
+            # Chunked prefill (PREFILL_CHUNK>0, decoder families;
+            # docs/chunked-prefill.md): the continuous loop splits
+            # prompts into PREFILL_CHUNK-token windows interleaved
+            # with decode chunks (engine/streams.py owns the jitted
+            # window executables).  Gated HERE — at startup, before
+            # readiness — so an unsupported combination can never
+            # silently serve monolithic.
+            self.prefill_chunk = int(getattr(cfg, "prefill_chunk", 0) or 0)
+            if self.prefill_chunk:
+                if bundle.prefill_chunk_fn is None:
+                    raise ValueError(
+                        f"PREFILL_CHUNK is not supported for "
+                        f"{bundle.name!r} (chunked prefill covers the "
+                        "decoder families: gpt2, llama)"
+                    )
+                if self._global_prefix_len() > 0:
+                    raise ValueError(
+                        "PREFILL_CHUNK and PROMPT_PREFIX are mutually "
+                        "exclusive (the global prefix overlay is seeded "
+                        "by init_decode_state, which chunked prefill "
+                        "bypasses); use PREFIX_CACHE=1"
+                    )
+                if self.paged_kv and self.prefill_chunk % self.kv_block_size:
+                    raise ValueError(
+                        f"PREFILL_CHUNK={self.prefill_chunk} must be a "
+                        f"multiple of KV_BLOCK_SIZE={self.kv_block_size} "
+                        "(block-aligned window boundaries keep per-chunk "
+                        "block growth exact)"
+                    )
+
             # Per-request prefix cache (PREFIX_CACHE=1, decoder
             # families without a global PROMPT_PREFIX): recurring
             # prompt prefixes — per-conversation system prompt +
@@ -366,6 +396,7 @@ class InferenceEngine:
             self.paged_kv = False
             self.kv_block_size = int(getattr(cfg, "kv_block_size", 16))
             self.kv_pool = None
+            self.prefill_chunk = 0
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
         self.last_decode_steps: int | None = None
@@ -531,25 +562,51 @@ class InferenceEngine:
             total += s * per_tok
         return int(total)
 
+    def chunked_prefill_applies(self, length: int) -> bool:
+        """Whether the continuous loop will prefill this prompt in
+        PREFILL_CHUNK windows: enabled AND (longer than one window, or
+        past the largest seq bucket — the monolithic wave path cannot
+        serve those).  One predicate shared by the loop's routing and
+        the admission ledger so the two can never drift."""
+        return bool(self.prefill_chunk) and (
+            int(length) > self.prefill_chunk
+            or int(length) > max(self.seq_buckets)
+        )
+
     def kv_blocks_estimate(self, feats: dict) -> tuple[int, int]:
         """Paged mode's exact ledger: (initial, worst) block counts for
         one stream.  ``initial`` covers the prompt bucket plus the
         fused first chunk — what admission charges up front; the loop
         grows block-by-block from there.  ``worst`` covers the
         request's own decode budget (max_tokens, chunk-rounded) — the
-        can-never-fit rejection bound."""
+        can-never-fit rejection bound.
+
+        Chunked prefill (PREFILL_CHUNK) shrinks ``initial`` to the
+        FIRST prefill window: the loop allocates the rest of the
+        prompt's blocks window-by-window as prefill proceeds, and a
+        stream checkpointed mid-prefill re-reserves this same
+        first-window footprint at resume — never the whole-prompt
+        estimate (``kv_bytes_for_resume`` reads this)."""
         from .kv_blocks import blocks_for
 
+        length = max(int(feats.get("length", 0) or 0), 1)
         s = bucket_for(
-            max(int(feats.get("length", 0) or 0), 1),
-            self.seq_buckets, self.replicas.seq_multiple(),
+            length, self.seq_buckets, self.replicas.seq_multiple(),
         )
         budget = int(
             math.ceil(self.budget_for(feats) / self.chunk_tokens)
             * self.chunk_tokens
         )
-        initial = blocks_for(s + self.chunk_tokens, self.kv_block_size)
-        worst = blocks_for(s + budget, self.kv_block_size)
+        if self.chunked_prefill_applies(length):
+            initial = blocks_for(
+                min(length, self.prefill_chunk), self.kv_block_size
+            )
+            # Chunked streams grow off their EXACT length, not the
+            # padded bucket (the windows write real positions only).
+            worst = blocks_for(length + budget, self.kv_block_size)
+        else:
+            initial = blocks_for(s + self.chunk_tokens, self.kv_block_size)
+            worst = blocks_for(s + budget, self.kv_block_size)
         return initial, max(initial, worst)
 
     def _collate_budget(self, feats: list[dict], bsz: int) -> np.ndarray:

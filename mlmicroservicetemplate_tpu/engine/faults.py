@@ -27,7 +27,8 @@ Two cooperating pieces, both OFF by default:
 FAULT_SPEC grammar (``;``-separated rules)::
 
     rule   := [site ":"] kind ["(" seconds ")"] trigger
-    site   := prefill | chunk | fetch | batch | grow | *   (default *)
+    site   := prefill | prefill_chunk | chunk | fetch | batch | grow | *
+              (default *; prefill_chunk = one chunked-prefill window)
     kind   := transient | fatal | hang | oob
     trigger:= "@" N ["+" M]   fire on matching dispatches N..N+M-1
             | "~" RATE        fire with probability RATE per dispatch
@@ -52,7 +53,7 @@ from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
-SITES = ("prefill", "chunk", "fetch", "batch", "grow", "*")
+SITES = ("prefill", "prefill_chunk", "chunk", "fetch", "batch", "grow", "*")
 KINDS = ("transient", "fatal", "hang", "oob")
 
 
@@ -104,7 +105,7 @@ class FaultRule:
 
 
 _RULE_RE = re.compile(
-    r"^(?:(?P<site>[a-z*]+):)?"
+    r"^(?:(?P<site>[a-z_*]+):)?"
     r"(?P<kind>[a-z]+)"
     r"(?:\((?P<arg>[0-9.]+)\))?"
     r"(?:@(?P<nth>\d+)(?:\+(?P<count>\d+))?|~(?P<rate>[0-9.]+))$"

@@ -144,12 +144,43 @@ class _Stream:
             self.cancelled.set()
 
 
+class _PrefillJob:
+    """One stream mid-chunked-prefill (PREFILL_CHUNK): host bookkeeping
+    for the prompt windows already consumed plus the KV carried
+    forward between them — a detached B=1 state (contiguous mode) or
+    the stream's own pool blocks + table row (paged mode, where the
+    windows write straight into the shared pools).  ``ready`` flips
+    once the prompt is exhausted; the job then waits only on a free
+    slot for its handoff."""
+
+    __slots__ = (
+        "st", "ids", "L", "p_len", "consumed", "s_total",
+        "state", "sb", "table_row", "ready", "t_in",
+    )
+
+    def __init__(self, st: _Stream, ids: np.ndarray, L: int):
+        self.st = st
+        self.ids = ids
+        self.L = L
+        self.p_len = 0  # adopted/seeded prefix length (cache hit)
+        self.consumed = 0  # absolute positions prefilled so far
+        self.s_total = 0  # contiguous state prompt width
+        self.state = None  # contiguous: detached B=1 device state
+        self.sb = None  # paged: StreamBlocks being grown
+        self.table_row = None  # paged: np table row (sentinel-padded)
+        self.ready = False
+        self.t_in = time.monotonic()
+
+
 class ContinuousDecodeLoop:
     """Slot-based batched decode over one InferenceEngine.
 
     Single owner thread runs: admit pending streams at chunk
     boundaries → one batched generate_chunk dispatch → route each
-    row's tokens to its stream → free done slots.
+    row's tokens to its stream → free done slots.  With chunked
+    prefill on (PREFILL_CHUNK), long prompts prefill as bounded
+    windows interleaved BETWEEN decode chunks instead of as one
+    monolithic dispatch in front of them — see ``_advance_prefill``.
     """
 
     def __init__(self, engine, cfg):
@@ -197,6 +228,52 @@ class ContinuousDecodeLoop:
             self._p_len = 0
         self._hist_w: int | None = None  # set by _build_empty_state
         self._kv_w: int | None = None
+        # Chunked prefill (PREFILL_CHUNK; docs/chunked-prefill.md):
+        # prompts longer than one window prefill as PREFILL_CHUNK-token
+        # dispatches interleaved with the decode chunks — a long prompt
+        # stalls live streams for at most ONE window's compute per
+        # iteration instead of its whole prefill.  The knob also lifts
+        # the loop's prompt ceiling past the largest seq bucket (the
+        # round-8 routing-bug class: oversized prompts now chunk here
+        # instead of silently falling to the legacy per-stream path),
+        # so the slot state is sized for ``max_prompt`` below.
+        self.prefill_chunk = int(getattr(engine, "prefill_chunk", 0) or 0)
+        self._prefilling: list[_PrefillJob] = []
+        self.prefill_chunk_dispatches = 0
+        self.prefill_stall_s = 0.0
+        self.prefill_budget = 0
+        if self.prefill_chunk:
+            if self.spec:
+                raise ValueError(
+                    "PREFILL_CHUNK does not compose with SPEC_CONTINUOUS "
+                    "(the spec slot insert rebuilds the drafting history "
+                    "from a monolithic collated prompt)"
+                )
+            if self._p_len:
+                raise ValueError(
+                    "PREFILL_CHUNK and PROMPT_PREFIX are mutually "
+                    "exclusive; use PREFIX_CACHE=1"
+                )
+            max_pos = int(getattr(engine.bundle.cfg, "max_position", 0) or 0)
+            cap = (
+                max_pos - engine.max_decode_len if max_pos else self.max_prompt
+            )
+            want = int(getattr(cfg, "prefill_max_prompt", 0) or 0) or cap
+            self.max_prompt = max(self.max_prompt, min(want, cap))
+            self.prefill_budget = (
+                int(getattr(cfg, "prefill_budget", 0) or 0)
+                or self.prefill_chunk
+            )
+            from ..scheduler.policy import PrefillPacer
+
+            self._pacer = PrefillPacer(
+                weight=int(getattr(cfg, "class_weight", 4))
+            )
+            self._prefill_jit = None
+            self._paged_prefill_jit = None
+            self._empty_state_jit = None
+            self._seed_prefix_fns: dict[int, Any] = {}
+            self._paged_handoff = None
         # Slot count must divide over the replica mesh's batch axis.
         mult = engine.replicas.pad_multiple()
         self.n_slots = -(-self.max_streams // mult) * mult
@@ -496,6 +573,10 @@ class ContinuousDecodeLoop:
         for st in self._pending_wave:
             self._finish(st, exc)
         self._pending_wave = []
+        for job in self._prefilling:
+            self._drop_job_resources(job)
+            self._finish(job.st, exc)
+        self._prefilling = []
         for st in self.queue.drain_all():
             self._finish(st, exc)
         for slot in list(self.active):
@@ -525,6 +606,7 @@ class ContinuousDecodeLoop:
                 if (
                     not self.active
                     and not self._inflight_chunks
+                    and not self._prefilling
                     and self.queue.qsize() == 0
                 ):
                     st = self.queue.pop(timeout=0.05, fits=self._fits)
@@ -549,7 +631,14 @@ class ContinuousDecodeLoop:
                 # wave — N prefill dispatches queue on the device and a
                 # single combined transfer fetches all their first
                 # chunks, so a wave costs one round-trip, not N.
-                while len(wave) + len(self.active) < self.n_slots:
+                # Streams mid-chunked-prefill count against the slot
+                # bound too: they were admitted first and will need a
+                # slot at handoff — later short prompts must not
+                # strand them slot-less.
+                while (
+                    len(wave) + len(self.active) + len(self._prefilling)
+                    < self.n_slots
+                ):
                     st = self.queue.pop_nowait(fits=self._fits)
                     if st is None:
                         break
@@ -616,6 +705,11 @@ class ContinuousDecodeLoop:
                 if self._pending_admissions:
                     self._admit_complete(self._pending_admissions)
                     self._pending_admissions = []
+                # Chunked prefill rides BEHIND the decode dispatch and
+                # the wave admission: live streams' next chunk is
+                # already queued on the device, so a window here delays
+                # decode cadence by at most its own compute.
+                advanced = self._advance_prefill()
                 if len(self._inflight_chunks) > self.chain_depth:
                     self._deliver_oldest()
                 elif self._inflight_chunks and not dispatched:
@@ -625,7 +719,10 @@ class ContinuousDecodeLoop:
                     # stream tail — the dominant cost at short decode
                     # budgets).
                     self._deliver_all()
-                elif not dispatched and not wave and not self.active:
+                elif (
+                    not dispatched and not advanced and not wave
+                    and not self.active
+                ):
                     # Waiters exist but none fit the KV budget (no
                     # admission, no work in flight): poll, don't spin.
                     time.sleep(0.01)
@@ -642,6 +739,11 @@ class ContinuousDecodeLoop:
                     self._finish(st, e)
                     n_lost += 1
                 self._pending_wave = []
+                for job in self._prefilling:
+                    self._drop_job_resources(job)
+                    self._finish(job.st, e)
+                    n_lost += 1
+                self._prefilling = []
                 for slot in list(self.active):
                     st = self.active.get(slot)
                     if st is not None:
@@ -664,6 +766,10 @@ class ContinuousDecodeLoop:
                 if self.supervisor is not None and self.supervisor.failed:
                     self._stop.set()
         # Shutdown: end every remaining consumer cleanly.
+        for job in self._prefilling:
+            self._drop_job_resources(job)
+            self._finish(job.st, StreamClosedError("server stopping"))
+        self._prefilling = []
         for st in self.queue.drain_all():
             self._finish(st, StreamClosedError("server stopping"))
         for slot in list(self.active):
@@ -741,6 +847,15 @@ class ContinuousDecodeLoop:
         for st in self._pending_wave:
             recovered += self._checkpoint_requeue(st)
         self._pending_wave = []
+        for job in self._prefilling:
+            if self.paged and job.sb is not None:
+                # Deref into the OLD pool (discarded below) so the
+                # StreamBlocks object can't double-free later.
+                job.sb.release()
+                job.sb = None
+            job.state = None
+            recovered += self._checkpoint_requeue(job.st)
+        self._prefilling = []
         for slot in list(self.active):
             st = self.active.pop(slot)
             if self.paged and st.blocks is not None:
@@ -929,6 +1044,20 @@ class ContinuousDecodeLoop:
                 ))
                 continue
             ok.append(st)
+        if self.prefill_chunk:
+            # Chunked routing: prompts longer than one window (or past
+            # the largest bucket) become backlog jobs driven by
+            # _advance_prefill; short prompts keep the monolithic wave
+            # path (one fused dispatch per wave stays the cheaper shape
+            # for them).
+            chunked = [
+                st for st in ok
+                if eng.chunked_prefill_applies(int(st.feats["length"]))
+            ]
+            if chunked:
+                ok = [st for st in ok if st not in chunked]
+                for st in chunked:
+                    self._start_prefill_job(st)
         if not ok:
             return started
         with eng._lock:
@@ -1240,6 +1369,503 @@ class ContinuousDecodeLoop:
             if self.paged and eng.prefix_cache is not None:
                 self._donate_paged(st, slot)
 
+    # -- chunked prefill (PREFILL_CHUNK) -------------------------------
+
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt tokens admitted but not yet prefilled (observability;
+        read from other threads as a snapshot)."""
+        return sum(max(0, j.L - j.consumed) for j in list(self._prefilling))
+
+    def _prefill_fn(self):
+        if self._prefill_jit is None:
+            import jax
+
+            self._prefill_jit = jax.jit(self.engine.bundle.prefill_chunk_fn)
+        return self._prefill_jit
+
+    def _paged_prefill_fn(self):
+        if self._paged_prefill_jit is None:
+            import jax
+
+            self._paged_prefill_jit = jax.jit(
+                self.engine.bundle.paged_prefill_chunk_fn
+            )
+        return self._paged_prefill_jit
+
+    def _empty_prefill_fn(self):
+        if self._empty_state_jit is None:
+            import jax
+
+            self._empty_state_jit = jax.jit(
+                self.engine.bundle.empty_state_fn, static_argnums=(1, 2, 3)
+            )
+        return self._empty_state_jit
+
+    def _seed_prefix_state(self, state, pkv, p_len: int):
+        """Copy a contiguous prefix-cache hit's KV into rows [0, p_len)
+        of a fresh chunked-prefill state and mark them valid — the
+        chunked counterpart of ``_start_prefixed``'s cache seeding; the
+        suffix then prefills window by window from position p_len."""
+        if p_len not in self._seed_prefix_fns:
+            import jax
+
+            def seed(st, pk):
+                def put(c, e):
+                    if isinstance(c, tuple):  # (int8 payload, scale)
+                        return tuple(
+                            ci.at[:, :p_len].set(ei.astype(ci.dtype))
+                            for ci, ei in zip(c, e)
+                        )
+                    return c.at[:, :p_len].set(e.astype(c.dtype))
+
+                return st._replace(
+                    cache_k=[put(c, e) for c, e in zip(st.cache_k, pk["k"])],
+                    cache_v=[put(c, e) for c, e in zip(st.cache_v, pk["v"])],
+                    key_valid=st.key_valid.at[:, :p_len].set(1),
+                )
+
+            self._seed_prefix_fns[p_len] = jax.jit(seed)
+        return self._seed_prefix_fns[p_len](state, pkv)
+
+    def _paged_handoff_fn(self):
+        """Paged handoff: the stream's KV already lives in its blocks
+        (the windows wrote it), so going live is pure row-field
+        surgery — key_valid/write_idx/pos/last_token/done/tokens/sample
+        of one slot row."""
+        if self._paged_handoff is None:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def ins_row(dst, src, slot):
+                pad = [(0, 0)] + [
+                    (0, int(d) - int(s))
+                    for d, s in zip(dst.shape[1:], src.shape[1:])
+                ]
+                srcp = jnp.pad(src.astype(dst.dtype), pad)
+                start = (slot,) + (0,) * (dst.ndim - 1)
+                return lax.dynamic_update_slice(dst, srcp, start)
+
+            def handoff(batched, kv_row, w_idx, pos, last, done, toks, sp,
+                        slot):
+                return batched._replace(
+                    key_valid=ins_row(batched.key_valid, kv_row, slot),
+                    write_idx=ins_row(batched.write_idx, w_idx, slot),
+                    pos=ins_row(batched.pos, pos, slot),
+                    last_token=ins_row(batched.last_token, last, slot),
+                    done=ins_row(batched.done, done, slot),
+                    tokens=ins_row(batched.tokens, toks, slot),
+                    sample=jax.tree.map(
+                        lambda d, s: ins_row(d, s, slot), batched.sample, sp
+                    ),
+                )
+
+            self._paged_handoff = jax.jit(handoff)
+        return self._paged_handoff
+
+    def _chunked_prefix_usable(self, L: int):
+        """Static-shape guard for prefix-cache hits on the CHUNKED
+        path: the seeded prefix + suffix windows must fit the slot
+        width and the model's position table."""
+        eng = self.engine
+        max_pos = int(getattr(eng.bundle.cfg, "max_position", 1 << 30))
+
+        def usable(p_len: int) -> bool:
+            if L + eng.max_decode_len > max_pos:
+                return False
+            if self.paged:
+                # Pins are block-aligned by the build gate; defensive.
+                return p_len % self.block_size == 0 and p_len < L
+            from .engine import bucket_for
+
+            s_suf = bucket_for(
+                max(L - p_len, 1), eng.seq_buckets,
+                eng.replicas.seq_multiple(),
+            )
+            return p_len + s_suf <= self.max_prompt
+
+        return usable
+
+    def _start_prefill_job(self, st: _Stream) -> None:
+        """Create one chunked-prefill backlog job: match the prefix
+        cache ONCE (hits adopt donor blocks / seed cached KV and
+        suffix-prefill in windows), allocate the paged table or the
+        detached contiguous state, and queue it for
+        ``_advance_prefill``."""
+        from .kv_blocks import PagedPrefix, StreamBlocks
+
+        eng = self.engine
+        L = int(st.feats["length"])
+        ids = np.asarray(st.feats["input_ids"], np.int32)[:L]
+        # TTFT admission-mode label: the API layer reads this off the
+        # SAME feats dict it submitted (set before any recast copies).
+        st.feats["prefill_mode"] = "chunked"
+        job = _PrefillJob(st, ids, L)
+        try:
+            p_len, pkv = 0, None
+            if eng.prefix_cache is not None:
+                m = eng.prefix_cache.match(
+                    ids, L, usable=self._chunked_prefix_usable(L)
+                )
+                if m is not None:
+                    p_len, pkv = m
+                    if self.paged:
+                        if isinstance(pkv, PagedPrefix):
+                            st.shared_ids = list(pkv.block_ids)
+                            pkv = None
+                        else:
+                            p_len, pkv = 0, None
+            job.p_len = p_len
+            job.consumed = p_len
+            if self.paged:
+                if self._state is None:
+                    self._build_empty_state()
+                st.s_lo = p_len
+                # Exact-growth base: the windows write REAL positions
+                # only, so decode growth runs off L, not the padded
+                # bucket — the ledger stays within one block of the
+                # live token count.
+                st.s_base = L
+                job.sb = StreamBlocks(self.pool, self.block_size)
+                if st.shared_ids:
+                    job.sb.adopt(st.shared_ids)
+                job.table_row = np.full(
+                    self.nb_max, self.pool.num_blocks, np.int32
+                )
+                job.table_row[: len(job.sb.ids)] = job.sb.ids
+            else:
+                from .engine import bucket_for
+
+                s_suf = bucket_for(
+                    max(L - p_len, 1), eng.seq_buckets,
+                    eng.replicas.seq_multiple(),
+                )
+                job.s_total = p_len + s_suf
+                with eng._lock:
+                    job.state = self._empty_prefill_fn()(
+                        eng.params, 1, job.s_total, eng.max_decode_len
+                    )
+                    if p_len:
+                        job.state = self._seed_prefix_state(
+                            job.state, pkv, p_len
+                        )
+        except Exception as e:
+            self._drop_job_resources(job)
+            self._fail_streams([st], e)
+            return
+        self._prefilling.append(job)
+
+    def _drop_job_resources(self, job: _PrefillJob) -> None:
+        """Return a job's KV (paged blocks / the detached state)."""
+        if job.sb is not None:
+            job.sb.release()
+            job.sb = None
+            if self.admission is not None:
+                self.admission.note_pool()
+        job.state = None
+
+    def _checkpoint_job(self, job: _PrefillJob) -> bool:
+        """Mid-prefill checkpoint: nothing was delivered yet, so resume
+        is a clean token-identical restart through admission.  Blocks
+        release NOW — a waiting checkpoint holds ZERO ledger
+        commitment and re-reserves only its first window at dequeue
+        (``kv_bytes_for_resume``), never the whole-prompt estimate."""
+        self._drop_job_resources(job)
+        return self._checkpoint_requeue(job.st)
+
+    def _fail_prefill_job(self, job: _PrefillJob, exc: Exception) -> None:
+        """Window-dispatch failure: release the job's KV, then the
+        shared prefill failure policy (fatal device fault under a
+        supervisor → checkpoint-requeue + engine rebuild at the next
+        iteration top; anything else errors only this consumer)."""
+        self._drop_job_resources(job)
+        self._fail_streams([job.st], exc)
+
+    def _dispatch_prefill_window(self, job: _PrefillJob) -> None:
+        """One PREFILL_CHUNK window for ``job``: pad the prompt slice,
+        grow the block table to cover it (paged — the chunk-by-chunk
+        allocation that replaces whole-prompt reservation), dispatch
+        under the ``prefill_chunk`` fault site.  Raises OutOfBlocks
+        (caller checkpoints) or the dispatch's own failure."""
+        import jax.numpy as jnp
+
+        eng = self.engine
+        c = self.prefill_chunk
+        start = job.consumed
+        end = min(start + c, job.L)
+        ids_w = np.zeros((1, c), np.int32)
+        mask_w = np.zeros((1, c), np.int32)
+        ids_w[0, : end - start] = job.ids[start:end]
+        mask_w[0, : end - start] = 1
+        if self.paged:
+            # Fault-injection point, like decode growth: an injected
+            # OutOfBlocks exercises the mid-prefill checkpoint path.
+            eng.fault_point("grow")
+            self._reclaim_then_ensure(job.sb, end)
+            job.table_row[: len(job.sb.ids)] = job.sb.ids
+            if self._state is None:
+                self._build_empty_state()
+            with eng._lock:
+                self._state = eng.dispatch_guard(
+                    "prefill_chunk",
+                    lambda: self._paged_prefill_fn()(
+                        eng.params, self._state,
+                        jnp.asarray(job.table_row), ids_w, mask_w,
+                        np.int32(start),
+                    ),
+                )
+            if self.admission is not None:
+                self.admission.note_pool()
+        else:
+            with eng._lock:
+                job.state = eng.dispatch_guard(
+                    "prefill_chunk",
+                    lambda: self._prefill_fn()(
+                        eng.params, job.state, ids_w, mask_w, np.int32(start)
+                    ),
+                )
+        job.consumed = end
+        self.prefill_chunk_dispatches += 1
+        metrics.PREFILL_CHUNKS.labels(eng.bundle.name).inc()
+
+    def _handoff_job(self, job: _PrefillJob) -> bool:
+        """Prompt exhausted: flip the stream live in a slot — the
+        normal slot-insert path's chunked twin.  The row starts at
+        decode step 0 with ``write_idx = L-1`` (the first shared-chunk
+        step re-embeds the last prompt token exactly like
+        ``init_decode_state`` arranges), so its first tokens ride the
+        next batched chunk.  Returns True when the stream went live."""
+        st = job.st
+        eng = self.engine
+        if st.cancelled.is_set():
+            self._drop_job_resources(job)
+            self._release(st)
+            return False
+        slot = None
+        try:
+            if self._state is None:
+                self._build_empty_state()
+            slot = self.free.pop()
+            sp, sampled = eng._collate_sample([st.feats], 1)
+            last = np.asarray([job.ids[-1]], np.int32)
+            w_idx = np.asarray([job.L - 1], np.int32)
+            zero = np.zeros(1, np.int32)
+            not_done = np.zeros(1, bool)
+            if self.paged:
+                kv_row = np.zeros(
+                    (1, self.nb_max * self.block_size), np.int32
+                )
+                kv_row[0, : job.L] = 1
+                toks_row = np.full(
+                    (1, eng.max_decode_len),
+                    int(getattr(eng.bundle.cfg, "pad_id", 0)), np.int32,
+                )
+                with eng._lock:
+                    self._state = self._paged_handoff_fn()(
+                        self._state, kv_row, w_idx, zero, last, not_done,
+                        toks_row, sp, np.int32(slot),
+                    )
+                st.blocks = job.sb
+                job.sb = None
+                self._table[slot] = job.table_row
+                self._dispatched_steps[slot] = 0
+                if self.admission is not None:
+                    self.admission.note_pool()
+            else:
+                final = job.state._replace(
+                    write_idx=w_idx, pos=zero, last_token=last,
+                    done=not_done, sample=sp,
+                )
+                with eng._lock:
+                    self._state = self._insert_fn()(
+                        self._state, final, np.int32(slot), np.int32(0)
+                    )
+        except Exception as e:
+            if slot is not None:
+                self.free.append(slot)
+            self._drop_job_resources(job)
+            self._finish(st, e)
+            return False
+        self.active[slot] = st
+        if sampled:
+            self.sampled_slots.add(slot)
+        # Chunked streams donate like monolithic admissions do at
+        # insert (growing-conversation rule included); non-fatal.
+        if eng.prefix_cache is not None:
+            try:
+                if self.paged:
+                    self._donate_paged(st, slot)
+                else:
+                    p_ins = eng.prefix_cache.bucket_for_insert(job.L)
+                    if (
+                        p_ins is not None
+                        and (job.p_len == 0 or p_ins > job.p_len)
+                        and not eng.prefix_cache.contains(job.ids, p_ins)
+                    ):
+                        with eng._lock:
+                            eng.prefix_cache.insert(
+                                job.ids, p_ins,
+                                eng._capture_prefix(job.state, p_ins, 0),
+                            )
+            except Exception:
+                log.exception("chunked prefix donation failed (non-fatal)")
+        job.state = None
+        return True
+
+    def _advance_prefill(self) -> bool:
+        """Interleave pending prefill windows BEHIND this iteration's
+        decode dispatch: live streams pay at most ``prefill_budget``
+        tokens of window compute per chunk boundary (the head-of-line
+        bound this feature exists for), idle compute backfills the
+        backlog unbounded, and the pacer starves batch-class prefill
+        while interactive decode runs.  Returns True when any window
+        dispatched or handoff completed (the loop must not sleep)."""
+        if not self.prefill_chunk:
+            return False
+        eng = self.engine
+        if not self._prefilling:
+            metrics.PREFILL_BACKLOG.labels(eng.bundle.name).set(0)
+            return False
+        from ..scheduler.policy import INTERACTIVE, DeadlineExceededError
+        from .kv_blocks import OutOfBlocks
+
+        advanced = False
+        t0 = time.monotonic()
+        live = bool(self.active)
+        interactive_live = any(
+            s.klass == INTERACTIVE and not s.cancelled.is_set()
+            for s in self.active.values()
+        )
+        # Stale/cancelled jobs drop before any device work.
+        for job in list(self._prefilling):
+            st = job.st
+            if st.cancelled.is_set():
+                self._prefilling.remove(job)
+                self._drop_job_resources(job)
+                self._release(st)
+            elif (
+                not st.started
+                and st.deadline is not None
+                and time.monotonic() > st.deadline
+            ):
+                self._prefilling.remove(job)
+                self._drop_job_resources(job)
+                self._shed("deadline")
+                self._finish(st, DeadlineExceededError(
+                    "deadline passed mid-prefill; stream shed before "
+                    "its first token"
+                ))
+        # Ready jobs (prompt exhausted) wait only on a free slot.
+        for job in [j for j in self._prefilling if j.ready]:
+            if not self.free:
+                break
+            self._prefilling.remove(job)
+            if self._handoff_job(job):
+                advanced = True
+        budget = self.prefill_budget if live else (1 << 30)
+        jobs = sorted(
+            [j for j in self._prefilling if not j.ready],
+            key=lambda j: (
+                0 if j.st.klass == INTERACTIVE else 1,
+                j.st.deadline if j.st.deadline is not None else float("inf"),
+                j.t_in,
+            ),
+        )
+        for job in jobs:
+            if budget <= 0:
+                break
+            if live and not self._pacer.allow(job.st.klass, interactive_live):
+                continue
+            try:
+                self._dispatch_prefill_window(job)
+            except OutOfBlocks:
+                # Pool dry mid-prefill: checkpoint and re-queue for a
+                # token-identical restart when blocks free up — the
+                # prefill mirror of _grow_for_dispatch's preemption.
+                metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+                self._prefilling.remove(job)
+                self._checkpoint_job(job)
+                continue
+            except Exception as e:
+                self._prefilling.remove(job)
+                self._fail_prefill_job(job, e)
+                if self._fault_pending is not None:
+                    break  # shared recovery runs at the iteration top
+                continue
+            advanced = True
+            budget -= self.prefill_chunk
+            if job.consumed >= job.L:
+                job.ready = True
+                if self.free:
+                    self._prefilling.remove(job)
+                    self._handoff_job(job)
+        if live and advanced:
+            # Host-observed decode-cadence delay: the time this chunk
+            # boundary spent on prefill dispatches while streams were
+            # live (the device-side window rides behind the decode
+            # dispatch, so this bounds — not equals — the stall).
+            dt = time.monotonic() - t0
+            self.prefill_stall_s += dt
+            metrics.PREFILL_STALL.labels(eng.bundle.name).inc(dt)
+        metrics.PREFILL_BACKLOG.labels(eng.bundle.name).set(
+            self.prefill_backlog_tokens()
+        )
+        return advanced
+
+    def _warm_prefill(self) -> None:
+        """Compile the chunked-prefill executables off the request
+        path: the empty-state builder + window forward per bucket
+        width (contiguous) or the pool-writing window + row handoff
+        (paged).  Long prompts past the bucket list still compile
+        their width on first admission — the documented cost of
+        lifting the prompt ceiling."""
+        import jax.numpy as jnp
+
+        eng = self.engine
+        c = self.prefill_chunk
+        ids_w = np.ones((1, c), np.int32)
+        mask_w = np.ones((1, c), np.int32)
+        if self.paged:
+            from .kv_blocks import OutOfBlocks, StreamBlocks
+
+            sb = StreamBlocks(self.pool, self.block_size)
+            try:
+                sb.ensure(c)
+            except OutOfBlocks:
+                return
+            table_row = np.full(self.nb_max, self.pool.num_blocks, np.int32)
+            table_row[: len(sb.ids)] = sb.ids
+            try:
+                sp, _ = eng._collate_sample(
+                    [{"input_ids": ids_w[0], "length": np.int32(c)}], 1
+                )
+                with eng._lock:
+                    self._state = self._paged_prefill_fn()(
+                        eng.params, self._state, jnp.asarray(table_row),
+                        ids_w, mask_w, np.int32(0),
+                    )
+                    self._state = self._paged_handoff_fn()(
+                        self._state,
+                        np.zeros((1, self.nb_max * self.block_size), np.int32),
+                        np.zeros(1, np.int32), np.zeros(1, np.int32),
+                        np.zeros(1, np.int32), np.ones(1, bool),
+                        np.zeros((1, eng.max_decode_len), np.int32),
+                        sp, np.int32(0),
+                    )
+            finally:
+                sb.release()
+            return
+        for s in eng.seq_buckets:
+            if not eng.chunked_prefill_applies(s):
+                continue
+            with eng._lock:
+                st1 = self._empty_prefill_fn()(
+                    eng.params, 1, s, eng.max_decode_len
+                )
+                self._prefill_fn()(
+                    eng.params, st1, ids_w, mask_w, np.int32(0)
+                )
+
     def _build_empty_state(self) -> None:
         """All-slots-done decode state from a max-bucket prefill
         template (shapes/dtypes only; every row starts dead).  Spec
@@ -1250,7 +1876,9 @@ class ContinuousDecodeLoop:
         import jax
 
         eng = self.engine
-        s_max = max(eng.seq_buckets)
+        # Chunked prefill widens the admissible prompt ceiling past the
+        # largest bucket; slots must hold the widest insertable state.
+        s_max = self.max_prompt
         feats = {"input_ids": np.ones(s_max, np.int32), "length": np.int32(s_max)}
         with eng._lock:
             ids, mask, _ = eng._collate_text([feats])
@@ -2017,6 +2645,8 @@ class ContinuousDecodeLoop:
                     jax.block_until_ready(
                         jax.tree.leaves(self._state)[0]
                     )
+        if self.prefill_chunk:
+            self._warm_prefill()
         if self._auto_depth:
             self._tune_chain_depth()
         # Reset to all-dead so warm inserts never leak into serving.
@@ -2077,6 +2707,8 @@ class ContinuousDecodeLoop:
                     eng.chunk_tokens, flag,
                 )
                 jax.device_get(toks)
+        if self.prefill_chunk:
+            self._warm_prefill()
         if self._auto_depth:
             self._tune_chain_depth_paged()
         self._build_empty_state()

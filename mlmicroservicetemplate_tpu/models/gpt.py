@@ -500,6 +500,157 @@ def generate_chunk_paged(
     return state, jnp.transpose(toks)
 
 
+# ---------------------------------------------------------------------------
+# chunked prefill (PREFILL_CHUNK; engine/streams.py drives the windows)
+
+
+def empty_decode_state(
+    params: Params,
+    cfg: GPTConfig,
+    batch: int,
+    s_total: int,
+    max_len: int,
+    dtype=jnp.float32,
+) -> GPTState:
+    """All-zero decode state sized for a chunked prefill: caches span
+    ``s_total`` prompt positions plus the decode budget, every row
+    born done.  ``prefill_chunk`` fills the prompt region window by
+    window; the continuous loop flips the row live (write_idx /
+    last_token / done / sample) once the prompt is exhausted, at which
+    point the state is positionally what ``init_decode_state`` would
+    have produced for the same prompt."""
+    from .sampling import greedy_params
+
+    total = s_total + max_len
+    cache = [
+        jnp.zeros((batch, total, cfg.num_heads, cfg.head_dim), dtype)
+        for _ in params["layers"]
+    ]
+    return GPTState(
+        cache_k=cache,
+        cache_v=list(cache),
+        key_valid=jnp.zeros((batch, total), jnp.int32),
+        write_idx=jnp.zeros((batch,), jnp.int32),
+        pos=jnp.zeros((batch,), jnp.int32),
+        last_token=jnp.zeros((batch,), jnp.int32),
+        done=jnp.ones((batch,), bool),
+        tokens=jnp.full((batch, max_len), cfg.pad_id, jnp.int32),
+        sample=greedy_params(batch),
+    )
+
+
+def _window_mask(base_valid: jax.Array, chunk_mask: jax.Array, start):
+    """[B, 1, C, total] attention mask for one prefill window: every
+    already-valid cache position (``base_valid`` [B, total] bool —
+    previous windows, or an adopted/seeded prefix) plus the causal,
+    pad-gated in-window prefix.  ``start`` is traced, so one
+    executable serves every window of a prompt."""
+    b, c = chunk_mask.shape
+    total = base_valid.shape[1]
+    pos_k = jnp.arange(total)[None, :]  # [1, total]
+    off = pos_k - start  # key offset into the window
+    in_win = (off >= 0) & (off < c)
+    wvalid = jnp.take_along_axis(
+        chunk_mask.astype(jnp.int32),
+        jnp.clip(jnp.broadcast_to(off, (b, total)), 0, c - 1),
+        axis=1,
+    )
+    win_keys = in_win & (wvalid != 0)  # [B, total]
+    causal = off[:, None, :] <= jnp.arange(c)[None, :, None]  # [1, C, total]
+    return (base_valid[:, None, :] | (win_keys[:, None, :] & causal))[:, None]
+
+
+def prefill_chunk(
+    params: Params,
+    cfg: GPTConfig,
+    state: GPTState,
+    chunk_ids: jax.Array,  # [B, C] window of the prompt, right-padded
+    chunk_mask: jax.Array,  # [B, C]
+    start,  # traced scalar: absolute position of chunk_ids[:, 0]
+    dtype=jnp.float32,
+) -> GPTState:
+    """Consume one prompt window [start, start+C) into the decode
+    state: K/V written at absolute positions, ``key_valid`` extended,
+    each window query attending to the whole already-prefilled prefix
+    plus its causal in-window context — token-identical to the
+    monolithic prompt forward, one bounded dispatch at a time.  The
+    last window's pad tail writes junk K/V past the prompt (exactly
+    like monolithic prefill's bucket padding): ``key_valid`` never
+    marks it, and decode overwrites each position in the same step
+    that validates it."""
+    b, c = chunk_ids.shape
+    rows = jnp.arange(b)[:, None]
+    pos_w = jnp.broadcast_to(start + jnp.arange(c)[None, :], (b, c))
+    x = embed(params["wte"], chunk_ids, dtype)
+    x = x + embed(params["wpe"], jnp.minimum(pos_w, cfg.max_position - 1), dtype)
+    mask = _window_mask(state.key_valid != 0, chunk_mask, start)
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B, C, H, D]
+        ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
+        cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = mha_attention(q, ck, cv, mask=mask)
+        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
+        x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
+    key_valid = state.key_valid.at[rows, pos_w].set(
+        chunk_mask.astype(jnp.int32), mode="drop"
+    )
+    return state._replace(cache_k=new_k, cache_v=new_v, key_valid=key_valid)
+
+
+def paged_prefill_chunk(
+    params: Params,
+    cfg: GPTConfig,
+    state: PagedState,
+    table_row: jax.Array,  # [T] this stream's block table (sentinel-padded)
+    chunk_ids: jax.Array,  # [1, C]
+    chunk_mask: jax.Array,  # [1, C]
+    start,
+    dtype=jnp.float32,
+) -> PagedState:
+    """One prompt window written straight into the stream's pool
+    blocks (PREFILL_CHUNK × PAGED_KV): K/V scatter through the block
+    table at absolute positions; attention reads back through a dense
+    gather of the stream's own blocks (adopted CoW prefix blocks
+    included, so a prefix-cache hit suffix-prefills in chunks with no
+    KV copy).  Only the pool leaves change — the slot rows' logical
+    fields belong to OTHER streams and are untouched; this stream's
+    row fields land at handoff (engine/streams.py).  Valid keys are
+    exactly the positions below ``start``: the prompt is contiguous
+    from 0, so no per-row key_valid is needed mid-prefill."""
+    from ..ops.paged_attention import gather_pages, scatter_pages
+
+    b, c = chunk_ids.shape  # b == 1: prefill windows are per-stream
+    bs = state.cache_k[0].shape[1]
+    pos_w = jnp.broadcast_to(start + jnp.arange(c)[None, :], (b, c))
+    x = embed(params["wte"], chunk_ids, dtype)
+    x = x + embed(params["wpe"], jnp.minimum(pos_w, cfg.max_position - 1), dtype)
+    total = table_row.shape[0] * bs
+    base_valid = jnp.broadcast_to(jnp.arange(total)[None, :] < start, (b, total))
+    mask = _window_mask(base_valid, chunk_mask, start)
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h)
+        ck = scatter_pages(state.cache_k[li], table_row, k1[0], bs, start=start)
+        cv = scatter_pages(state.cache_v[li], table_row, v1[0], bs, start=start)
+        new_k.append(ck)
+        new_v.append(cv)
+        kd = gather_pages(ck, table_row[None], bs)
+        vd = gather_pages(cv, table_row[None], bs)
+        ctx = mha_attention(q, kd, vd, mask=mask)
+        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
+        x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
+    return state._replace(cache_k=new_k, cache_v=new_v)
+
+
 def init_paged_state(
     params: Params,
     cfg: GPTConfig,

@@ -672,6 +672,177 @@ def generate_chunk_paged(params: Params, cfg: LlamaConfig, state, table,
     return state, jnp.transpose(toks)
 
 
+# ---------------------------------------------------------------------------
+# chunked prefill (PREFILL_CHUNK) — gpt.py's window contract at GQA
+# width, composed with the int8 KV cache.
+
+
+def empty_decode_state(
+    params: Params,
+    cfg: LlamaConfig,
+    batch: int,
+    s_total: int,
+    max_len: int,
+    dtype=jnp.float32,
+) -> GPTState:
+    """All-zero decode state for chunked prefill (see
+    ``gpt.empty_decode_state``); under ``kv_quant`` the cache entries
+    are (int8 payload, scale) pairs mirroring ``init_decode_state``'s
+    zero/ones init, so per-window quantized writes land in the exact
+    slab layout monolithic prefill would have produced."""
+    from .sampling import greedy_params
+
+    total = s_total + max_len
+    shape = (batch, total, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.kv_quant:
+        cache_k = [
+            (jnp.zeros(shape, jnp.int8), jnp.ones(shape[:3] + (1,), dtype))
+            for _ in params["layers"]
+        ]
+        cache_v = [
+            (jnp.zeros(shape, jnp.int8), jnp.ones(shape[:3] + (1,), dtype))
+            for _ in params["layers"]
+        ]
+    else:
+        cache_k = [jnp.zeros(shape, dtype) for _ in params["layers"]]
+        cache_v = list(cache_k)
+    return GPTState(
+        cache_k=cache_k,
+        cache_v=cache_v,
+        key_valid=jnp.zeros((batch, total), jnp.int32),
+        write_idx=jnp.zeros((batch,), jnp.int32),
+        pos=jnp.zeros((batch,), jnp.int32),
+        last_token=jnp.zeros((batch,), jnp.int32),
+        done=jnp.ones((batch,), bool),
+        tokens=jnp.full((batch, max_len), cfg.pad_id, jnp.int32),
+        sample=greedy_params(batch),
+    )
+
+
+def prefill_chunk(
+    params: Params,
+    cfg: LlamaConfig,
+    state: GPTState,
+    chunk_ids: jax.Array,  # [B, C]
+    chunk_mask: jax.Array,  # [B, C]
+    start,
+    dtype=jnp.float32,
+) -> GPTState:
+    """One prompt window into the contiguous cache (see
+    ``gpt.prefill_chunk``): RoPE at each absolute window position, GQA
+    cache writes (quantized per token-head under ``kv_quant`` — the
+    same per-token scheme as monolithic prefill, so window grouping
+    never changes the stored bytes)."""
+    from .gpt import _window_mask
+
+    b, c = chunk_ids.shape
+    rows = jnp.arange(b)[:, None]
+    pos_w = jnp.broadcast_to(start + jnp.arange(c)[None, :], (b, c))
+    x = embed(params["embed"], chunk_ids, dtype)
+    cos, sin = _rope_tables(
+        cfg, jnp.minimum(pos_w, cfg.max_position - 1), dtype
+    )  # [B, C, Dh]
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    mask = _window_mask(state.key_valid != 0, chunk_mask, start)
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
+        a = layer["attn"]
+        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        ck = _write_kv(state.cache_k[li], rows, pos_w, k1, dtype)
+        cv = _write_kv(state.cache_v[li], rows, pos_w, v1, dtype)
+        new_k.append(ck)
+        new_v.append(cv)
+        ctx = _cache_attention(cfg, q, ck, cv, mask)
+        x = x + dense(a["o"], merge_heads(ctx))
+        h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
+        m = layer["mlp"]
+        x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
+    key_valid = state.key_valid.at[rows, pos_w].set(
+        chunk_mask.astype(jnp.int32), mode="drop"
+    )
+    return state._replace(cache_k=new_k, cache_v=new_v, key_valid=key_valid)
+
+
+def _paged_scatter_entry(cache, table_row, vals, bs: int, start, dtype):
+    """Scatter one window's K (or V) rows [C, KVH, D] through the
+    table into a dense pool or an (int8, scale) pool pair."""
+    from ..ops.paged_attention import scatter_pages
+
+    if isinstance(cache, tuple):
+        q8, sc = kv_quantize(vals)
+        return (
+            scatter_pages(cache[0], table_row, q8, bs, start=start),
+            scatter_pages(cache[1], table_row, sc.astype(dtype), bs, start=start),
+        )
+    return scatter_pages(cache, table_row, vals, bs, start=start)
+
+
+def paged_prefill_chunk(
+    params: Params,
+    cfg: LlamaConfig,
+    state,  # gpt.PagedState
+    table_row: jax.Array,
+    chunk_ids: jax.Array,  # [1, C]
+    chunk_mask: jax.Array,
+    start,
+    dtype=jnp.float32,
+):
+    """One prompt window straight into pool blocks (see
+    ``gpt.paged_prefill_chunk``), at GQA width and composed with the
+    int8 pool pairs."""
+    from ..ops.paged_attention import gather_pages
+
+    from .gpt import _window_mask
+
+    b, c = chunk_ids.shape  # b == 1
+    entry = state.cache_k[0]
+    bs = entry[0].shape[1] if isinstance(entry, tuple) else entry.shape[1]
+    pos_w = jnp.broadcast_to(start + jnp.arange(c)[None, :], (b, c))
+    x = embed(params["embed"], chunk_ids, dtype)
+    cos, sin = _rope_tables(cfg, jnp.minimum(pos_w, cfg.max_position - 1), dtype)
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    total = table_row.shape[0] * bs
+    base_valid = jnp.broadcast_to(jnp.arange(total)[None, :] < start, (b, total))
+    mask = _window_mask(base_valid, chunk_mask, start)
+
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
+        a = layer["attn"]
+        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        ck = _paged_scatter_entry(state.cache_k[li], table_row, k1[0], bs, start, dtype)
+        cv = _paged_scatter_entry(state.cache_v[li], table_row, v1[0], bs, start, dtype)
+        new_k.append(ck)
+        new_v.append(cv)
+        if isinstance(ck, tuple):
+            ctx = mha_attention_kv8(
+                q,
+                _repeat_kv(gather_pages(ck[0], table_row[None], bs), cfg.n_rep),
+                _repeat_kv(gather_pages(ck[1], table_row[None], bs), cfg.n_rep),
+                _repeat_kv(gather_pages(cv[0], table_row[None], bs), cfg.n_rep),
+                _repeat_kv(gather_pages(cv[1], table_row[None], bs), cfg.n_rep),
+                mask=mask,
+            )
+        else:
+            ctx = mha_attention(
+                q,
+                _repeat_kv(gather_pages(ck, table_row[None], bs), cfg.n_rep),
+                _repeat_kv(gather_pages(cv, table_row[None], bs), cfg.n_rep),
+                mask=mask,
+            )
+        x = x + dense(a["o"], merge_heads(ctx))
+        h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
+        m = layer["mlp"]
+        x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
+    return state._replace(cache_k=new_k, cache_v=new_v)
+
+
 def init_paged_state(
     params: Params,
     cfg: LlamaConfig,

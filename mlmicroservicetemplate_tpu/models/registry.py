@@ -81,6 +81,19 @@ class ModelBundle:
     # layout; engine/kv_blocks.py owns the host-side tables).  None =
     # family does not support PAGED_KV.
     paged_chunk_fn: Callable | None = None
+    # Chunked prefill (PREFILL_CHUNK, decoder-only families;
+    # docs/chunked-prefill.md).  empty_state_fn(params, batch, s_total,
+    # max_len) -> all-dead decode state sized for a chunked prefill;
+    # prefill_chunk_fn(params, state, ids, mask, start) consumes one
+    # [B, C] prompt window at absolute position ``start`` (traced);
+    # paged_prefill_chunk_fn(params, paged_state, table_row, ids,
+    # mask, start) is the PAGED_KV variant writing straight into the
+    # stream's pool blocks.  None = family does not support
+    # PREFILL_CHUNK (encoder-decoders prefill the decoder from a start
+    # token — there is no prompt to chunk).
+    empty_state_fn: Callable | None = None
+    prefill_chunk_fn: Callable | None = None
+    paged_prefill_chunk_fn: Callable | None = None
 
     # -- host-side single-item pre/post ------------------------------------
     def preprocess(self, item: "RawItem") -> dict[str, np.ndarray]:
@@ -575,6 +588,21 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     def paged_chunk_fn(p, state, table, n_steps: int, sample: bool = False):
         return gpt_mod.generate_chunk_paged(p, cfg, state, table, n_steps, sample)
 
+    def empty_state_fn(p, batch: int, s_total: int, max_len: int):
+        return gpt_mod.empty_decode_state(
+            p, cfg, batch, s_total, max_len, dtype=policy.compute_jnp
+        )
+
+    def prefill_chunk_fn(p, state, ids, mask, start):
+        return gpt_mod.prefill_chunk(
+            p, cfg, state, ids, mask, start, dtype=policy.compute_jnp
+        )
+
+    def paged_prefill_chunk_fn(p, state, table_row, ids, mask, start):
+        return gpt_mod.paged_prefill_chunk(
+            p, cfg, state, table_row, ids, mask, start, dtype=policy.compute_jnp
+        )
+
     from . import spec as spec_mod
 
     init_spec_fn = spec_mod.make_init_spec_fn(p_len)
@@ -606,6 +634,9 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         init_spec_fn=init_spec_fn,
         spec_chunk_fn=spec_chunk_fn,
         paged_chunk_fn=paged_chunk_fn,
+        empty_state_fn=empty_state_fn,
+        prefill_chunk_fn=prefill_chunk_fn,
+        paged_prefill_chunk_fn=paged_prefill_chunk_fn,
     )
 
 
@@ -764,6 +795,21 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
             p, cfg, state, table, n_steps, sample
         )
 
+    def empty_state_fn(p, batch: int, s_total: int, max_len: int):
+        return llama_mod.empty_decode_state(
+            p, cfg, batch, s_total, max_len, dtype=policy.compute_jnp
+        )
+
+    def prefill_chunk_fn(p, state, ids, mask, start):
+        return llama_mod.prefill_chunk(
+            p, cfg, state, ids, mask, start, dtype=policy.compute_jnp
+        )
+
+    def paged_prefill_chunk_fn(p, state, table_row, ids, mask, start):
+        return llama_mod.paged_prefill_chunk(
+            p, cfg, state, table_row, ids, mask, start, dtype=policy.compute_jnp
+        )
+
     from . import spec as spec_mod
 
     init_spec_fn = spec_mod.make_init_spec_fn(p_len)
@@ -794,6 +840,9 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         init_spec_fn=init_spec_fn,
         spec_chunk_fn=spec_chunk_fn,
         paged_chunk_fn=paged_chunk_fn,
+        empty_state_fn=empty_state_fn,
+        prefill_chunk_fn=prefill_chunk_fn,
+        paged_prefill_chunk_fn=paged_prefill_chunk_fn,
     )
 
 
@@ -923,6 +972,40 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
                 "PAGED_KV requires REPLICAS=1: the block pool has no "
                 "batch axis to shard over the replica mesh"
             )
+    if int(getattr(svc_cfg, "prefill_chunk", 0) or 0) > 0:
+        # Chunked prefill (docs/chunked-prefill.md) changes the loop's
+        # dispatch unit; every unsupported combination rejects loudly —
+        # a silently-monolithic deployment would report interference
+        # wins it isn't getting.
+        if bundle.prefill_chunk_fn is None:
+            raise ValueError(
+                f"PREFILL_CHUNK is not supported for {svc_cfg.model_name!r} "
+                "(chunked prefill covers the decoder families gpt2/llama; "
+                "encoder-decoders like t5 prefill the DECODER from a start "
+                "token — the encoder pass has no incremental KV to chunk)"
+            )
+        if getattr(svc_cfg, "prompt_prefix", None):
+            raise ValueError(
+                "PREFILL_CHUNK and PROMPT_PREFIX are mutually exclusive: "
+                "the global prefix overlay seeds positions 0..P inside "
+                "init_decode_state, which chunked prefill bypasses — use "
+                "PREFIX_CACHE=1, whose hits suffix-prefill in chunks"
+            )
+        if getattr(svc_cfg, "spec_continuous", False):
+            raise ValueError(
+                "PREFILL_CHUNK does not compose with SPEC_CONTINUOUS "
+                "(the spec slot insert rebuilds the drafting history from "
+                "a monolithic collated prompt; planned follow-up)"
+            )
+        if getattr(svc_cfg, "paged_kv", False):
+            bs = int(getattr(svc_cfg, "kv_block_size", 16))
+            if int(svc_cfg.prefill_chunk) % bs:
+                raise ValueError(
+                    f"PREFILL_CHUNK={svc_cfg.prefill_chunk} must be a "
+                    f"multiple of KV_BLOCK_SIZE={bs} so every window "
+                    "boundary is block-aligned (per-chunk block growth "
+                    "stays exact)"
+                )
     if getattr(svc_cfg, "prefix_cache", False):
         if not bundle.supports_prefix:
             raise ValueError(

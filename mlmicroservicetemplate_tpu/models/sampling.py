@@ -27,7 +27,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-_NEG_INF = jnp.float32(-1e9)
+# Python float, NOT jnp.float32: this module can be first imported
+# from inside a jit trace (model fns import it lazily), and a
+# module-level jnp constant created under an active trace would be a
+# tracer — leaking into every later executable that reads it.  A weak
+# float promotes to the logits' f32 in jnp.where identically.
+_NEG_INF = -1e9
 
 
 class SampleParams(NamedTuple):

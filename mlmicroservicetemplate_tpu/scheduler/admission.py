@@ -103,7 +103,11 @@ class AdmissionController:
         """Footprint a checkpointed stream re-reserves at dequeue, off
         its CURRENT feats — the recast resume folds delivered tokens
         into the prompt, so the admission-time estimate can undershoot
-        the new prompt bucket."""
+        the new prompt bucket.  A stream checkpointed MID-PREFILL
+        (chunked prefill: fatal fault, dry pool) holds zero blocks
+        while it waits and re-reserves only its first prefill window —
+        ``kv_blocks_estimate`` returns the chunked initial, never the
+        whole-prompt estimate."""
         if self.paged and self.pool is not None:
             initial, _ = self.engine.kv_blocks_estimate(feats)
             return initial * self.pool.block_bytes

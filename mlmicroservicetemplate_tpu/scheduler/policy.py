@@ -65,6 +65,34 @@ def _dl(item) -> float:
     return item.deadline if item.deadline is not None else float("inf")
 
 
+class PrefillPacer:
+    """Deadline-aware chunk budget for prefill–decode interleaving
+    (PREFILL_CHUNK; engine/streams.py).
+
+    Policy, mirroring the dequeue weights: interactive-class prefill
+    always advances (it IS the latency-sensitive work — holding it
+    back only moves its TTFT); batch-class prefill is starved while
+    interactive-class decode is live, EXCEPT one window every
+    ``weight`` boundaries so it cannot starve forever; with no
+    interactive decode running, batch prefill backfills the idle
+    compute freely."""
+
+    def __init__(self, weight: int = 4):
+        self.weight = max(1, int(weight))
+        self._held = 0
+
+    def allow(self, job_klass: str, interactive_active: bool) -> bool:
+        """May a ``job_klass`` prefill window dispatch at this chunk
+        boundary, given whether interactive decode is live?"""
+        if job_klass == INTERACTIVE or not interactive_active:
+            return True
+        self._held += 1
+        if self._held >= self.weight:
+            self._held = 0
+            return True
+        return False
+
+
 class DeadlineQueue:
     """Bounded two-class EDF wait queue (see module docstring).
 

@@ -211,6 +211,27 @@ class ServiceConfig(BaseModel):
     # Tokens per KV block in paged mode.  Must divide every seq bucket
     # (prefix sharing relies on bucket-aligned block boundaries).
     kv_block_size: int = 16
+    # Chunked prefill with prefill–decode interleaving
+    # (docs/chunked-prefill.md): prompts longer than PREFILL_CHUNK
+    # tokens prefill in PREFILL_CHUNK-token windows interleaved with
+    # the continuous loop's decode chunks, so one long prompt never
+    # stalls every live stream for its whole prefill.  Also lifts the
+    # loop's prompt ceiling past the largest seq bucket (up to the
+    # model's position budget) — oversized prompts chunk instead of
+    # falling to the legacy per-stream path.  0 = off (the seed's
+    # monolithic prefill).  Under PAGED_KV must be a multiple of
+    # KV_BLOCK_SIZE; rejected for t5 / PROMPT_PREFIX / SPEC_CONTINUOUS.
+    prefill_chunk: int = 0
+    # Max prefill tokens interleaved per loop iteration while decode
+    # streams are live (idle compute backfills unbounded).  0 = one
+    # chunk (PREFILL_CHUNK) per iteration — decode cadence never waits
+    # behind more than one window's compute.
+    prefill_budget: int = 0
+    # Prompt-length ceiling for chunked admission; 0 = auto (the
+    # model's position budget: max_position - decode budget).  Bounds
+    # the continuous loop's slot width (contiguous mode) / block-table
+    # width (paged), so cap it when HBM is tight.
+    prefill_max_prompt: int = 0
     # Interactive arrivals may preempt batch-class streams (checkpoint
     # the cursor, free the slot, re-queue for token-identical resume)
     # when every slot is busy.  Only reachable with MAX_STREAM_QUEUE>0.
@@ -333,6 +354,15 @@ class ServiceConfig(BaseModel):
             raise ValueError("KV_BLOCK_SIZE must be in [1, 1024]")
         return v
 
+    @field_validator("prefill_chunk", "prefill_budget", "prefill_max_prompt")
+    @classmethod
+    def _check_prefill(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError(
+                "PREFILL_CHUNK/PREFILL_BUDGET/PREFILL_MAX_PROMPT must be >= 0"
+            )
+        return v
+
     @field_validator("fault_spec")
     @classmethod
     def _check_fault_spec(cls, v: str | None) -> str | None:
@@ -374,7 +404,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX,
       SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
-      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, FAULT_SPEC, FAULT_SEED,
+      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, PREFILL_CHUNK,
+      PREFILL_BUDGET, PREFILL_MAX_PROMPT, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
       ENGINE_RESTARTS_MAX, SUPERVISE.
     """
@@ -423,6 +454,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "class_weight": "CLASS_WEIGHT",
         "max_stream_queue": "MAX_STREAM_QUEUE",
         "kv_block_size": "KV_BLOCK_SIZE",
+        "prefill_chunk": "PREFILL_CHUNK",
+        "prefill_budget": "PREFILL_BUDGET",
+        "prefill_max_prompt": "PREFILL_MAX_PROMPT",
         "fault_seed": "FAULT_SEED",
         "dispatch_retries": "DISPATCH_RETRIES",
         "engine_restarts_max": "ENGINE_RESTARTS_MAX",

@@ -98,8 +98,28 @@ SHED = Counter(
 )
 TTFT = Histogram(
     "stream_ttft_seconds",
-    "Streaming time-to-first-token-chunk (submit to first event)",
-    ["model"], buckets=_LATENCY_BUCKETS,
+    "Streaming time-to-first-token-chunk (submit to first event), by "
+    "admission mode (chunked = PREFILL_CHUNK windows, monolithic = "
+    "one fused prefill dispatch)",
+    ["model", "mode"], buckets=_LATENCY_BUCKETS,
+)
+PREFILL_CHUNKS = Counter(
+    "prefill_chunks_total",
+    "Prompt windows dispatched by chunked prefill (PREFILL_CHUNK)",
+    ["model"],
+)
+PREFILL_STALL = Counter(
+    "prefill_stall_seconds",
+    "Host time spent dispatching prefill windows while decode streams "
+    "were live — the decode-cadence delay chunked prefill bounds to "
+    "one window (device-side serialization rides behind the decode "
+    "dispatch, so this is the interleaving overhead, not a full stall)",
+    ["model"],
+)
+PREFILL_BACKLOG = Gauge(
+    "prefill_backlog_tokens",
+    "Prompt tokens admitted but not yet prefilled (chunked backlog)",
+    ["model"],
 )
 CLASS_QUEUE_DEPTH = Gauge(
     "sched_class_queue_depth",

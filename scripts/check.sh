@@ -50,6 +50,23 @@ else
     echo "== chaos suite skipped (CHAOS=0) =="
 fi
 
+# Chunked-prefill smoke: 3-point PREFILL_CHUNK matrix, each run under
+# a prefill_chunk-site FAULT_SPEC injection through the supervised
+# loop, expecting token-identical completion and a drained block pool
+# (chaos tier, so it stays out of tier-1).  PREFILL_SMOKE=0 skips.
+if [ "${PREFILL_SMOKE:-1}" != "0" ]; then
+    echo "== chunked-prefill smoke matrix =="
+    for chunk in 8 16 32; do
+        echo "-- PREFILL_SMOKE_CHUNK=$chunk (prefill_chunk:fatal@2)"
+        timeout -k 10 240 env JAX_PLATFORMS=cpu PREFILL_SMOKE_CHUNK="$chunk" \
+            PREFILL_SMOKE_SPEC="prefill_chunk:fatal@2" \
+            python -m pytest tests/test_prefill_chunked.py::test_prefill_chunk_smoke \
+            -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+    done
+else
+    echo "== chunked-prefill smoke skipped (PREFILL_SMOKE=0) =="
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

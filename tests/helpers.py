@@ -172,6 +172,17 @@ def tiny_gpt_bundle(seed: int = 0) -> ModelBundle:
         paged_chunk_fn=lambda p, s, t, n, sample=False: gpt_mod.generate_chunk_paged(
             p, cfg, s, t, n, sample
         ),
+        empty_state_fn=lambda p, b, s, ml: gpt_mod.empty_decode_state(
+            p, cfg, b, s, ml
+        ),
+        prefill_chunk_fn=lambda p, st, i, m, start: gpt_mod.prefill_chunk(
+            p, cfg, st, i, m, start
+        ),
+        paged_prefill_chunk_fn=(
+            lambda p, st, tr, i, m, start: gpt_mod.paged_prefill_chunk(
+                p, cfg, st, tr, i, m, start
+            )
+        ),
         supports_prefix=True,
     )
 
@@ -197,6 +208,17 @@ def tiny_llama_bundle(seed: int = 0, kv_quant: bool = False) -> ModelBundle:
         ),
         paged_chunk_fn=lambda p, s, t, n, sample=False: llama_mod.generate_chunk_paged(
             p, cfg, s, t, n, sample
+        ),
+        empty_state_fn=lambda p, b, s, ml: llama_mod.empty_decode_state(
+            p, cfg, b, s, ml
+        ),
+        prefill_chunk_fn=lambda p, st, i, m, start: llama_mod.prefill_chunk(
+            p, cfg, st, i, m, start
+        ),
+        paged_prefill_chunk_fn=(
+            lambda p, st, tr, i, m, start: llama_mod.paged_prefill_chunk(
+                p, cfg, st, tr, i, m, start
+            )
         ),
         supports_prefix=True,
     )

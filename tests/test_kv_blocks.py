@@ -124,21 +124,28 @@ def test_kv_estimate_counts_global_prefix_rows():
 
 
 @pytest.mark.parametrize("family", ["gpt", "llama", "llama-int8"])
-def test_ceiling_estimate_bounds_paged_blocks(family):
-    """Property: for every (prompt length, decode budget) the ceiling
-    estimate bounds the paged ledger to within ONE block (the paged
-    tax is internal fragmentation of the final partial block, strictly
-    < KV_BLOCK_SIZE tokens per stream) — the fail-safe the scheduler
-    relies on: paged admission can never commit meaningfully more than
-    the contiguous ceiling would have, while typically committing far
-    less (initial << worst until decode actually grows)."""
+@pytest.mark.parametrize("prefill_chunk", [0, 16, 32])
+def test_ceiling_estimate_bounds_paged_blocks(family, prefill_chunk):
+    """Property: for every (prompt length, decode budget, PREFILL_CHUNK)
+    the ceiling estimate bounds the paged ledger to within ONE block
+    (the paged tax is internal fragmentation of the final partial
+    block, strictly < KV_BLOCK_SIZE tokens per stream) — the fail-safe
+    the scheduler relies on: paged admission can never commit
+    meaningfully more than the contiguous ceiling would have, while
+    typically committing far less (initial << worst until decode
+    actually grows).  Chunked prefill shrinks ``initial`` further — to
+    the first window — and the worst bound tightens to the EXACT
+    length the windows write, still inside the ceiling."""
     if family == "gpt":
         bundle, quant = tiny_gpt_bundle(), None
     elif family == "llama":
         bundle, quant = tiny_llama_bundle(), None
     else:
         bundle, quant = tiny_llama_bundle(kv_quant=True), "int8"
-    eng = _engine(bundle, paged_kv=True, kv_block_size=16, quant_kv=quant)
+    eng = _engine(
+        bundle, paged_kv=True, kv_block_size=16, quant_kv=quant,
+        prefill_chunk=prefill_chunk,
+    )
     bb = eng.kv_pool.block_bytes
     assert bb == eng.kv_token_bytes() * 16
     for length in (1, 5, 16, 17, 31, 32, 50, 64):
@@ -153,6 +160,11 @@ def test_ceiling_estimate_bounds_paged_blocks(family):
             # blocks + first chunk (same one-block fragmentation
             # bound), not prompt bucket + FULL budget.
             assert initial * bb < est + bb
+            if eng.chunked_prefill_applies(length):
+                # Chunked admission charges exactly the first window.
+                assert initial == blocks_for(
+                    min(length, prefill_chunk), 16
+                ), (family, prefill_chunk, length)
 
 
 def test_kv_token_bytes_quant_math():

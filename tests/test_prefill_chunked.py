@@ -1,0 +1,479 @@
+"""Chunked prefill with prefill–decode interleaving (PREFILL_CHUNK).
+
+The judged contracts:
+1. Window-by-window prefill is TOKEN-IDENTICAL to the monolithic
+   prompt forward at the model level — gpt/llama × {fp, int8-KV},
+   any chunk size (divisor or not of the prompt/bucket).
+2. The continuous loop under PREFILL_CHUNK serves the same tokens as
+   the monolithic engine, contiguous AND paged, greedy AND
+   pinned-seed sampled, prefix-cache-hit suffix chunks included; the
+   paged pool drains to zero when streams end (exact ledger).
+3. The round-8 routing-bug class: a prompt LONGER than the largest
+   seq bucket is admitted via chunked prefill — never silently routed
+   to the legacy per-stream path.
+4. A stream checkpointed MID-PREFILL (fatal fault at the
+   ``prefill_chunk`` site, or a dry pool) resumes token-identically,
+   and while it waits it holds zero blocks and re-reserves only its
+   first window (``kv_bytes_for_resume``).
+5. PREFILL_CHUNK=0 leaves the seed behavior untouched; invalid
+   combinations reject at build time.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.kv_blocks import blocks_for
+from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+from mlmicroservicetemplate_tpu.engine.supervisor import Supervisor
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import TINY_GPT, TINY_LLAMA, tiny_gpt_bundle, tiny_llama_bundle
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2, 4))
+    kw.setdefault("seq_buckets", (16, 32))
+    kw.setdefault("max_decode_len", 12)
+    kw.setdefault("stream_chunk_tokens", 4)
+    kw.setdefault("max_streams", 4)
+    return ServiceConfig(**kw)
+
+
+async def _consume(gen):
+    out = []
+    async for c in gen:
+        out.extend(np.asarray(c).tolist())
+    return out
+
+
+def _run(cdl, feats_list):
+    async def body():
+        return await asyncio.gather(
+            *[_consume(cdl.submit_stream(dict(f))) for f in feats_list]
+        )
+
+    return asyncio.run(body())
+
+
+def _solo_tokens(engine, feats):
+    return np.concatenate(list(engine.generate_stream(dict(feats)))).tolist()
+
+
+def _wait_pool_drained(pool, allow: int = 0, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while pool.used_blocks > allow and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return pool.used_blocks
+
+
+def _prompt(rng, n):
+    return rng.integers(5, 250, n).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# model-level window identity (no loop)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama", "llama-int8"])
+def test_model_prefill_window_identity(family):
+    """Chunked prompt windows produce the exact tokens monolithic
+    prefill does, for every chunk size — including non-divisors of
+    the prompt and of the bucket width."""
+    if family == "gpt":
+        from mlmicroservicetemplate_tpu.models import gpt as mod
+
+        cfg = mod.GPTConfig(**{**TINY_GPT, "eos_id": 1, "pad_id": 0})
+    else:
+        from mlmicroservicetemplate_tpu.models import llama as mod
+
+        cfg = mod.LlamaConfig(
+            **{**TINY_LLAMA, "eos_id": 1, "pad_id": 0},
+            kv_quant=family == "llama-int8",
+        )
+    params = mod.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    L, max_len = 19, 8
+    ids = _prompt(rng, L)
+    want = np.asarray(mod.greedy_generate(
+        params, cfg, jnp.asarray(ids[None]), jnp.ones((1, L), jnp.int32),
+        max_len,
+    ))
+    for c in (4, 7, 19, 32):
+        st = mod.empty_decode_state(params, cfg, 1, 24, max_len)
+        pos = 0
+        while pos < L:
+            end = min(pos + c, L)
+            w = np.zeros((1, c), np.int32)
+            m = np.zeros((1, c), np.int32)
+            w[0, : end - pos] = ids[pos:end]
+            m[0, : end - pos] = 1
+            st = mod.prefill_chunk(
+                params, cfg, st, jnp.asarray(w), jnp.asarray(m), np.int32(pos)
+            )
+            pos = end
+        st = st._replace(
+            write_idx=jnp.asarray([L - 1], jnp.int32),
+            pos=jnp.zeros(1, jnp.int32),
+            last_token=jnp.asarray([int(ids[-1])], jnp.int32),
+            done=jnp.zeros(1, bool),
+        )
+        st, _ = mod.generate_chunk(params, cfg, st, max_len)
+        np.testing.assert_array_equal(np.asarray(st.tokens), want, err_msg=str(c))
+
+
+# ---------------------------------------------------------------------------
+# continuous loop identity (contiguous × paged × families × sampling)
+
+
+@pytest.mark.parametrize(
+    "family,paged,quant",
+    [
+        ("gpt", False, False),
+        ("gpt", True, False),
+        ("llama", False, True),
+        ("llama", True, True),
+    ],
+)
+def test_loop_chunked_identity(family, paged, quant):
+    """Concurrent mixed-length streams under PREFILL_CHUNK serve the
+    exact tokens the monolithic engine does; prompts past the largest
+    bucket (45 > 32) join the loop via chunked admission; the paged
+    pool drains to zero (exact ledger under chunked growth)."""
+    bundle = (
+        tiny_gpt_bundle() if family == "gpt"
+        else tiny_llama_bundle(kv_quant=quant)
+    )
+    kw = dict(prefill_chunk=8, prefill_max_prompt=48)
+    if quant:
+        kw["quant_kv"] = "int8"
+    if paged:
+        kw.update(paged_kv=True, kv_block_size=8)
+    cfgc = _cfg(**kw)
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(
+        bundle, _cfg(**({"quant_kv": "int8"} if quant else {})),
+        ReplicaSet(make_mesh(1)),
+    )
+    rng = np.random.default_rng(0)
+    feats = [
+        {"input_ids": p, "length": np.int32(len(p))}
+        for p in (_prompt(rng, n) for n in (7, 19, 30, 45))
+    ]
+    solos = [_solo_tokens(eng0, f) for f in feats]
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    try:
+        outs = _run(cdl, feats)
+        assert outs == solos
+        assert cdl.prefill_chunk_dispatches > 0
+        if paged:
+            assert _wait_pool_drained(engc.kv_pool) == 0
+    finally:
+        cdl.stop()
+
+
+def test_loop_chunked_sampled_pinned_seed():
+    """A pinned-seed sampled stream admitted via chunked prefill draws
+    the exact token sequence the monolithic B=1 path draws (the row
+    starts its RNG chain at step 0 either way)."""
+    bundle = tiny_gpt_bundle()
+    cfgc = _cfg(prefill_chunk=8, prefill_max_prompt=48)
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(1)
+    f = {
+        "input_ids": _prompt(rng, 23), "length": np.int32(23),
+        "temperature": 0.9, "top_k": 20, "seed": 1234,
+    }
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    try:
+        assert _run(cdl, [f])[0] == _solo_tokens(eng0, f)
+    finally:
+        cdl.stop()
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_prefix_hit_suffix_chunks(paged):
+    """A prefix-cache hit suffix-prefills in windows: contiguous mode
+    seeds the cached KV rows, paged mode ADOPTS the donor's blocks
+    (CoW) and the windows attend through the shared table — output
+    token-identical to the cache-off engine either way."""
+    bundle = tiny_gpt_bundle()
+    kw = dict(prefill_chunk=8, prefill_max_prompt=48, prefix_cache=True)
+    if paged:
+        kw.update(paged_kv=True, kv_block_size=8)
+    cfgc = _cfg(**kw)
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    try:
+        rng = np.random.default_rng(0)
+        shared = _prompt(rng, 20)
+        p1 = np.concatenate([shared, _prompt(rng, 5)])
+        p2 = np.concatenate([shared, _prompt(rng, 14)])
+        f1 = {"input_ids": p1, "length": np.int32(len(p1))}
+        f2 = {"input_ids": p2, "length": np.int32(len(p2))}
+        _run(cdl, [f1])  # donor
+        hits0 = engc.prefix_cache.hits
+        out = _run(cdl, [f2])[0]
+        assert engc.prefix_cache.hits > hits0
+        eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+        assert out == _solo_tokens(eng0, f2)
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# round-8 routing-bug regression: oversized prompts must chunk, not
+# fall to the legacy per-stream path
+
+
+def test_oversized_prompt_routes_chunked_not_legacy():
+    from mlmicroservicetemplate_tpu.scheduler.batcher import Batcher
+
+    bundle = tiny_gpt_bundle()
+    cfgc = _cfg(prefill_chunk=16, prefill_max_prompt=64)
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(2)
+    p = _prompt(rng, 45)  # > max bucket 32
+    f = {"input_ids": p, "length": np.int32(45)}
+    want = _solo_tokens(eng0, f)
+
+    def _no_legacy(feats):
+        raise AssertionError(
+            "oversized prompt fell through to the legacy per-stream path"
+        )
+
+    submitted = dict(f)  # the API layer passes its dict through uncopied
+
+    async def body():
+        batcher = Batcher(engc, cfgc)
+        engc.generate_stream = _no_legacy  # any legacy routing = failure
+        try:
+            got = await _consume(batcher.submit_stream(submitted))
+        finally:
+            await batcher.stop()
+        return got
+
+    got = asyncio.run(body())
+    assert got == want
+    # And the marker the API layer uses for the TTFT mode label.
+    assert submitted.get("prefill_mode") == "chunked"
+
+
+def test_prefill_chunk_off_leaves_seed_routing():
+    """PREFILL_CHUNK=0: the loop's prompt ceiling stays the largest
+    bucket and no chunked machinery engages."""
+    bundle = tiny_gpt_bundle()
+    eng = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, _cfg())
+    assert cdl.prefill_chunk == 0
+    assert cdl.max_prompt == 32
+    assert eng.chunked_prefill_applies(64) is False
+
+
+# ---------------------------------------------------------------------------
+# mid-prefill checkpoint/resume + admission accounting
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_midprefill_fatal_checkpoint_resumes_identically(paged):
+    """A fatal device fault on the 2nd prefill window: the supervised
+    loop checkpoints the mid-prefill stream (its blocks release),
+    rebuilds the engine, and the resume restarts prefill for a
+    token-identical completion."""
+    bundle = tiny_gpt_bundle()
+    kw = dict(
+        prefill_chunk=8, prefill_max_prompt=48,
+        fault_spec="prefill_chunk:fatal@2", max_stream_queue=4,
+    )
+    if paged:
+        kw.update(paged_kv=True, kv_block_size=8)
+    cfgc = _cfg(**kw)
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(2)
+    f = {"input_ids": _prompt(rng, 26), "length": np.int32(26)}
+    solo = _solo_tokens(eng0, f)
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    cdl.supervisor = Supervisor(cfgc)
+    try:
+        assert _run(cdl, [f])[0] == solo
+        assert cdl.supervisor.restarts == 1
+        if paged:
+            assert _wait_pool_drained(engc.kv_pool) == 0
+    finally:
+        cdl.stop()
+
+
+def test_kv_bytes_for_resume_midprefill_is_first_window():
+    """Satellite fix: a stream checkpointed mid-prefill must commit
+    only its first window at resume, never the whole-prompt estimate
+    — and the estimate's chunked ``initial`` is exactly that window."""
+    from mlmicroservicetemplate_tpu.scheduler.admission import (
+        AdmissionController,
+    )
+
+    bundle = tiny_gpt_bundle()
+    cfgc = _cfg(
+        prefill_chunk=8, paged_kv=True, kv_block_size=8,
+        kv_budget_mb=64 * 4096 / 1e6,
+    )
+    eng = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    adm = AdmissionController(cfgc, eng)
+    feats = {"length": 30, "input_ids": np.arange(5, 35, dtype=np.int32)}
+    initial, worst = eng.kv_blocks_estimate(feats)
+    assert initial == blocks_for(8, 8) == 1
+    # Whole-prompt (monolithic) initial would have been ≥ 4 blocks.
+    assert worst >= blocks_for(30 + 12, 8)
+    assert adm.kv_bytes_for_resume(feats) == initial * eng.kv_pool.block_bytes
+
+
+@pytest.mark.parametrize("chunk,length", [(8, 19), (16, 30), (24, 30)])
+def test_ledger_bound_under_chunked_growth(chunk, length):
+    """Property over chunk sizes: while a chunked stream prefills and
+    decodes, the pool never holds more than ceil(tokens/block)+1
+    blocks for it — windows allocate off the EXACT length, not the
+    padded bucket — and everything returns at EOS."""
+    bundle = tiny_gpt_bundle()
+    cfgc = _cfg(
+        prefill_chunk=chunk, prefill_max_prompt=48,
+        paged_kv=True, kv_block_size=8,
+    )
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    assert engc.chunked_prefill_applies(length)
+    pool = engc.kv_pool
+    high = {"w": 0}
+    orig_alloc = pool.alloc
+
+    def alloc(n):
+        ids = orig_alloc(n)
+        high["w"] = max(high["w"], pool.used_blocks)
+        return ids
+
+    pool.alloc = alloc
+    rng = np.random.default_rng(3)
+    f = {"input_ids": _prompt(rng, length), "length": np.int32(length)}
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    try:
+        out = _run(cdl, [f])
+        assert len(out[0]) > 0
+        budget = engc.max_decode_len
+        assert high["w"] <= blocks_for(length + budget, 8) + 1
+        if length == 19:
+            # The discriminating win: the monolithic reservation at
+            # bucket 32 would have held blocks_for(32+12)=6.
+            assert high["w"] < 6
+        assert _wait_pool_drained(pool) == 0
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# gates + estimate plumbing
+
+
+def test_build_model_gates_prefill_chunk():
+    import json
+
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+    from mlmicroservicetemplate_tpu.utils.config import load_config
+
+    os.environ["LLAMA_CONFIG"] = json.dumps(
+        {k: v for k, v in TINY_LLAMA.items() if k not in ("eos_id", "pad_id")}
+    )
+    try:
+        base = {
+            "DEVICE": "cpu", "MODEL_NAME": "llama", "WARMUP": "0",
+            "PREFILL_CHUNK": "16", "SEQ_BUCKETS": "32,64",
+            "BATCH_BUCKETS": "1,2",
+        }
+        b = build_model(load_config(dict(base)))
+        assert b.prefill_chunk_fn is not None
+        with pytest.raises(ValueError, match="PREFILL_CHUNK is not supported"):
+            build_model(load_config(dict(base, MODEL_NAME="t5-small")))
+        with pytest.raises(ValueError, match="PROMPT_PREFIX"):
+            build_model(load_config(dict(base, PROMPT_PREFIX="sys")))
+        with pytest.raises(ValueError, match="SPEC_CONTINUOUS"):
+            build_model(load_config(dict(
+                base, SPEC_DECODE="ngram", SPEC_CONTINUOUS="1"
+            )))
+        with pytest.raises(ValueError, match="multiple of KV_BLOCK_SIZE"):
+            build_model(load_config(dict(
+                base, PAGED_KV="1", PREFILL_CHUNK="12", KV_BLOCK_SIZE="8",
+                SEQ_BUCKETS="32,64",
+            )))
+    finally:
+        del os.environ["LLAMA_CONFIG"]
+
+
+def test_status_and_metrics_surface():
+    """The loop exposes the counters /status and Prometheus read."""
+    bundle = tiny_gpt_bundle()
+    cfgc = _cfg(prefill_chunk=8, prefill_max_prompt=48)
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(4)
+    f = {"input_ids": _prompt(rng, 20), "length": np.int32(20)}
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    try:
+        submitted = dict(f)
+
+        async def body():
+            return await _consume(cdl.submit_stream(submitted))
+
+        asyncio.run(body())
+        assert cdl.prefill_chunk_dispatches >= 3  # 20 tokens / 8 per window
+        assert cdl.prefill_backlog_tokens() == 0
+        assert submitted.get("prefill_mode") == "chunked"
+        from mlmicroservicetemplate_tpu.utils import metrics
+
+        body = metrics.render()[0].decode()
+        assert "prefill_chunks_total" in body
+        assert "prefill_backlog_tokens" in body
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# check.sh smoke entry (chaos tier): PREFILL_CHUNK matrix × FAULT_SPEC
+
+
+@pytest.mark.chaos
+def test_prefill_chunk_smoke():
+    """scripts/check.sh runs this with PREFILL_SMOKE_CHUNK ∈ {8,16,32}
+    under a ``prefill_chunk``-site fault schedule, expecting
+    token-identical completion through the supervised loop."""
+    chunk = int(os.environ.get("PREFILL_SMOKE_CHUNK", "8"))
+    spec = os.environ.get("PREFILL_SMOKE_SPEC", "prefill_chunk:fatal@2")
+    cfgc = _cfg(
+        prefill_chunk=chunk, prefill_max_prompt=48, fault_spec=spec,
+        dispatch_timeout_s=2.0, dispatch_retries=2, dispatch_backoff_s=0.01,
+        paged_kv=True, kv_block_size=8, max_stream_queue=4,
+    )
+    bundle = tiny_gpt_bundle()
+    engc = InferenceEngine(bundle, cfgc, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(5)
+    feats = [
+        {"input_ids": p, "length": np.int32(len(p))}
+        for p in (_prompt(rng, n) for n in (26, 40))
+    ]
+    solos = [_solo_tokens(eng0, f) for f in feats]
+    cdl = ContinuousDecodeLoop(engc, cfgc)
+    cdl.supervisor = Supervisor(cfgc)
+    try:
+        outs = _run(cdl, feats)
+        assert outs == solos
+        assert _wait_pool_drained(engc.kv_pool) == 0
+    finally:
+        cdl.stop()
