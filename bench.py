@@ -27,6 +27,7 @@ import time
 N_LATENCY = 40
 N_THROUGHPUT = 192
 CONCURRENCY = 64
+N_ATTRIBUTION = 8
 TORCH_ITERS = 3
 TORCH_BATCH = 8
 
@@ -99,6 +100,43 @@ async def bench_serving() -> "tuple[dict, object]":
             await asyncio.gather(*(one() for _ in range(N_THROUGHPUT)))
             walls.append(time.perf_counter() - t0)
         wall = min(walls)
+
+        # Host-vs-device dispatch attribution (round 11): a short
+        # TRACE=1 window AFTER the measured passes — attribution mode
+        # block_until_ready's every dispatch, so it must never touch
+        # the headline numbers — then the per-site stat deltas say how
+        # much of each dispatch was host/relay vs device compute.  The
+        # r01–r05 "relay RTT dominates" reading stops being an
+        # inference and becomes a recorded split in every BENCH json.
+        from mlmicroservicetemplate_tpu.utils import tracing
+
+        attr_before = engine.dispatch_attribution()
+        restore = tracing.tracer() is not None
+        tracing.configure(True, 2048)
+        try:
+            for _ in range(N_ATTRIBUTION):
+                resp = await client.post("/predict", data=png, headers=headers)
+                assert resp.status == 200
+                await resp.read()
+        finally:
+            tracing.configure(restore)
+        attribution = {}
+        for site, a in engine.dispatch_attribution().items():
+            b = attr_before.get(
+                site, {"count": 0, "host_s": 0.0, "device_s": 0.0}
+            )
+            n = a["count"] - b["count"]
+            if n <= 0:
+                continue
+            host = a["host_s"] - b["host_s"]
+            dev = a["device_s"] - b["device_s"]
+            attribution[site] = {
+                "n": n,
+                "host_ms_avg": round(host / n * 1e3, 3),
+                "device_ms_avg": round(dev / n * 1e3, 3),
+                "host_share": round(host / (host + dev), 4)
+                if host + dev > 0 else None,
+            }
         import jax
 
         return {
@@ -116,6 +154,7 @@ async def bench_serving() -> "tuple[dict, object]":
             ),
             "backend": jax.default_backend(),
             "n_devices": engine.replicas.n_devices,
+            "dispatch_attribution": attribution,
         }, engine
     finally:
         await client.close()

@@ -113,6 +113,72 @@ class ServiceUnderTest:
         }
 
 
+async def scrape_histogram(client, name: str) -> dict:
+    """One Prometheus histogram family from a ``/metrics`` scrape,
+    summed over label children: ``{"count": float, "sum": float,
+    "buckets": {le: cumulative_count}}`` (le keys are floats,
+    ``math.inf`` for ``+Inf``).  Scrape-before/scrape-after plus
+    ``hist_delta`` isolates one measured section even though the
+    prometheus registry is process-global across service instances."""
+    resp = await client.get("/metrics")
+    assert resp.status == 200, await resp.text()
+    text = await resp.text()
+    out = {"count": 0.0, "sum": 0.0, "buckets": {}}
+    for line in text.splitlines():
+        if not line.startswith(name) or line.startswith("#"):
+            continue
+        head, value = line.rsplit(" ", 1)
+        value = float(value)
+        if head.startswith(f"{name}_count"):
+            out["count"] += value
+        elif head.startswith(f"{name}_sum"):
+            out["sum"] += value
+        elif head.startswith(f"{name}_bucket"):
+            labels = head.split("{", 1)[1].rstrip("}")
+            le = next(
+                kv.split("=", 1)[1].strip('"')
+                for kv in labels.split(",") if kv.startswith("le=")
+            )
+            le = math.inf if le == "+Inf" else float(le)
+            out["buckets"][le] = out["buckets"].get(le, 0.0) + value
+    return out
+
+
+def hist_delta(after: dict, before: dict) -> dict:
+    """Histogram delta (after − before) in ``scrape_histogram`` form."""
+    return {
+        "count": after["count"] - before["count"],
+        "sum": after["sum"] - before["sum"],
+        "buckets": {
+            le: c - before["buckets"].get(le, 0.0)
+            for le, c in after["buckets"].items()
+        },
+    }
+
+
+def hist_pctile(h: dict, q: float) -> float | None:
+    """Percentile estimate from cumulative buckets (linear
+    interpolation inside the landing bucket — the same arithmetic as
+    PromQL ``histogram_quantile``).  None on an empty histogram; a
+    percentile landing in the +Inf bucket reports that bucket's lower
+    edge (the largest finite ``le``)."""
+    total = h["count"]
+    if total <= 0:
+        return None
+    target = q * total
+    lo_edge, lo_count = 0.0, 0.0
+    for le in sorted(h["buckets"]):
+        c = h["buckets"][le]
+        if c >= target:
+            if math.isinf(le):
+                return lo_edge
+            span = c - lo_count
+            frac = (target - lo_count) / span if span > 0 else 1.0
+            return lo_edge + (le - lo_edge) * frac
+        lo_edge, lo_count = (0.0 if math.isinf(le) else le), c
+    return lo_edge
+
+
 def post_image(png: bytes):
     def make(client):
         return client.post(

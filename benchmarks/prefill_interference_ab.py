@@ -22,6 +22,15 @@ streams' TTFT.  The acceptance claim: the chunked arm strictly lowers
 short-stream TBT p99/max; the honest cost is the long prompt's own
 TTFT (its windows yield to decode — that is the policy working).
 
+Since round 11 the TBT cadence also comes from the SERVER's exported
+``stream_tbt_seconds`` histogram (utils/metrics.py) — scraped from
+``/metrics`` before/after each arm's measured section — so the
+aggregate series every dashboard reads and this harness's
+hand-computed client-side gaps must agree (``tbt_hist_*`` vs
+``tbt_*`` columns).  The client-side slice stays authoritative for
+the in-window stall (the histogram can't condition on the long
+prompt being in flight); the histogram covers every gap.
+
     python benchmarks/prefill_interference_ab.py            # current backend
     DEVICE=cpu python benchmarks/prefill_interference_ab.py # CPU sanity run
 
@@ -39,13 +48,21 @@ import time
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _here)
 sys.path.insert(0, os.path.dirname(_here))
-from harness import ServiceUnderTest, pctile  # noqa: E402
+from harness import (  # noqa: E402
+    ServiceUnderTest,
+    hist_delta,
+    hist_pctile,
+    pctile,
+    scrape_histogram,
+)
 
 # The service byte-tokenizes gpt2 text, so prompt length == byte count.
 SHORT_PROMPT = "the quick brown fox jumps over "  # 31 tokens < every chunk
 LONG_LEN = int(os.environ.get("PREFILL_AB_LONG", "448"))
 N_SHORT = 3
-SHORT_TOKENS = 48  # decode budget: keeps shorts live across the prefill
+# Decode budget: keeps shorts live across the prefill (shrink via env
+# for CPU smoke runs — a full-budget arm takes ~10 min on 1 vCPU).
+SHORT_TOKENS = int(os.environ.get("PREFILL_AB_SHORT_TOKENS", "48"))
 CHUNKS = tuple(
     int(c)
     for c in os.environ.get("PREFILL_AB_CHUNKS", "32,64,128").split(",")
@@ -120,6 +137,9 @@ async def run_arm(arm: str, prefill_chunk: int, dev: dict, rows: list):
         # Discard one warm probe (lazy one-time costs).
         gate0: asyncio.Event = asyncio.Event()
         await _short_stream(s.client, gate0, {})
+        # Server-side cadence series: delta over the measured section
+        # (the prometheus registry is process-global across arms).
+        tbt_before = await scrape_histogram(s.client, "stream_tbt_seconds")
         for _ in range(REPEATS):
             gate: asyncio.Event = asyncio.Event()
             shorts: dict = {}
@@ -142,12 +162,26 @@ async def run_arm(arm: str, prefill_chunk: int, dev: dict, rows: list):
                     if longd["t_launch"] <= b <= longd["t_done"]:
                         tbt_gaps.append(gap)
             await asyncio.sleep(0.5)  # drain the slot pool between reps
+        tbt_hist = hist_delta(
+            await scrape_histogram(s.client, "stream_tbt_seconds"),
+            tbt_before,
+        )
+    hist_p99 = hist_pctile(tbt_hist, 0.99)
     rows.append({
         "arm": arm,
         "tbt_p99_ms": round(pctile(tbt_gaps, 0.99) * 1e3, 1)
         if tbt_gaps else None,
         "tbt_max_ms": round(max(tbt_gaps) * 1e3, 1) if tbt_gaps else None,
         "tbt_all_p99_ms": round(pctile(tbt_all_gaps, 0.99) * 1e3, 1),
+        # The exported stream_tbt_seconds view of the same section:
+        # count must cover the client-observed gaps, p99 must agree
+        # with tbt_all_p99_ms up to bucket resolution.
+        "tbt_hist_p99_ms": round(hist_p99 * 1e3, 1)
+        if hist_p99 is not None else None,
+        "tbt_hist_n": int(tbt_hist["count"]),
+        "tbt_hist_mean_ms": round(
+            tbt_hist["sum"] / tbt_hist["count"] * 1e3, 1
+        ) if tbt_hist["count"] else None,
         "gaps_in_window": len(tbt_gaps),
         "long_ttft_ms": round(
             sorted(long_ttfts)[len(long_ttfts) // 2] * 1e3, 1
@@ -170,12 +204,14 @@ async def main() -> None:
     import jax
 
     backend = jax.default_backend()
-    print("\n| arm | tbt p99 (ms) | tbt max (ms) | long ttft (ms) "
-          "| short ttft p50 (ms) | gaps |", file=sys.stderr)
-    print("|---|---|---|---|---|---|", file=sys.stderr)
+    print("\n| arm | tbt p99 (ms) | tbt max (ms) | tbt hist p99 (ms) "
+          "| hist n | long ttft (ms) | short ttft p50 (ms) | gaps |",
+          file=sys.stderr)
+    print("|---|---|---|---|---|---|---|---|", file=sys.stderr)
     for r in rows:
         print(
             f"| {r['arm']} | {r['tbt_p99_ms']} | {r['tbt_max_ms']} "
+            f"| {r['tbt_hist_p99_ms']} | {r['tbt_hist_n']} "
             f"| {r['long_ttft_ms']} | {r['short_ttft_p50_ms']} "
             f"| {r['gaps_in_window']} |",
             file=sys.stderr,

@@ -23,7 +23,7 @@ from aiohttp import web
 
 from ..models.registry import KIND_SEQ2SEQ, ModelBundle, RawItem
 from ..scheduler import Batcher, DeadlineExceededError, QueueFullError
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 log = logging.getLogger(__name__)
 
@@ -63,12 +63,24 @@ async def request_id_middleware(request: web.Request, handler):
     """Echo (or mint) X-Request-Id on every response and convert any
     exception no handler mapped into the structured JSON 500 body —
     the log line and the client error share the same request_id, so an
-    operator can find the traceback for any failed call."""
+    operator can find the traceback for any failed call.
+
+    Also the TRACE=1 request-span anchor: one "request" span per call,
+    keyed by the same request id every downstream span carries.  The
+    span is recorded after the fact (``Tracer.add``) — event-loop
+    coroutines interleave on one thread, so stack-based parenting
+    would mis-attribute concurrent requests; correlation rides the
+    request id instead."""
     rid = request.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
     request["request_id"] = rid
+    tr = tracing.tracer()
+    t0 = time.monotonic()
+    status = 500
     try:
         resp = await handler(request)
+        status = resp.status
     except web.HTTPException as e:
+        status = e.status
         e.headers.setdefault("X-Request-Id", rid)
         raise
     except asyncio.CancelledError:
@@ -77,12 +89,19 @@ async def request_id_middleware(request: web.Request, handler):
         bundle = request.app[K_BUNDLE]
         metrics.REQUESTS.labels(bundle.name, "500").inc()
         log.exception(
-            "unhandled error on %s (request_id=%s)", request.path, rid
+            "unhandled error on %s (request_id=%s)", request.path, rid,
+            extra={"request_id": rid},
         )
         return web.json_response(
             _error_body(type(e).__name__, str(e) or "internal error", rid),
             status=500, headers={"X-Request-Id": rid},
         )
+    finally:
+        if tr is not None:
+            tr.add(
+                "request", cat="http", rid=rid, t0=t0,
+                path=request.path, method=request.method, status=status,
+            )
     if not resp.prepared:
         resp.headers.setdefault("X-Request-Id", rid)
     return resp
@@ -111,7 +130,9 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_get("/readyz", handle_readyz)
     app.router.add_get("/status", handle_status)
     app.router.add_get("/metrics", handle_metrics)
-    app.router.add_post("/debug/trace", handle_trace)
+    app.router.add_get("/debug/trace", handle_trace)
+    app.router.add_get("/debug/engine", handle_engine_debug)
+    app.router.add_post("/debug/profile", handle_profile)
 
     # A misconfigured CHAT_TEMPLATE must fail at STARTUP, not as
     # request-time 500s once the server already passed /readyz.
@@ -376,6 +397,9 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=str(e) or "undecodable payload")
     feats.update(sched)
+    # Span/log correlation key for every downstream layer (scheduler
+    # queue-wait, prefill windows, stream lifetime).
+    feats["request_id"] = request.get("request_id", "")
 
     if stream and bundle.kind == KIND_SEQ2SEQ:
         return await _stream_predict(request, feats, t0, item)
@@ -797,6 +821,7 @@ async def _openai_prologue(request: web.Request, to_prompt):
         metrics.REQUESTS.labels(bundle.name, "400").inc()
         raise web.HTTPBadRequest(reason=str(e) or "bad request")
     feats.update(sched)
+    feats["request_id"] = request.get("request_id", "")
     # OpenAI stream semantics: usage appears in a stream ONLY when the
     # client asked via stream_options.include_usage (then every chunk
     # carries "usage": null and one extra final chunk carries the
@@ -1111,6 +1136,17 @@ async def handle_status(request: web.Request) -> web.Response:
             "backlog_tokens": cdl.prefill_backlog_tokens(),
             "stall_seconds": round(cdl.prefill_stall_s, 4),
         }
+    tr = tracing.tracer()
+    body["observability"] = {
+        "trace": tr is not None,
+        "spans_created": tr.spans_created if tr is not None else 0,
+        "flight_ring": getattr(
+            getattr(engine, "flight", None), "size", 0
+        ),
+        "flight_dumps": getattr(
+            getattr(engine, "flight", None), "dumps", 0
+        ),
+    }
     err = app[K_STATE]["ready_error"]
     if err:
         body["ready_error"] = err
@@ -1130,28 +1166,84 @@ async def handle_metrics(request: web.Request) -> web.Response:
 
 
 async def handle_trace(request: web.Request) -> web.Response:
-    """On-demand device profiling (SURVEY.md §5 tracing plan): capture a
-    jax.profiler trace for N seconds while traffic flows, write a
-    perfetto-compatible dump, return its path.
+    """``GET /debug/trace?last=N`` — the span tracer's ring as Chrome
+    trace-event JSON (load in https://ui.perfetto.dev or
+    chrome://tracing).  Empty ``traceEvents`` (with
+    ``otherData.trace_enabled: false``) when TRACE=0."""
+    tr = tracing.tracer()
+    last = request.query.get("last")
+    try:
+        last = int(last) if last is not None else None
+    except ValueError:
+        raise web.HTTPBadRequest(reason='"last" must be an integer')
+    if last is not None and last <= 0:
+        raise web.HTTPBadRequest(reason='"last" must be > 0')
+    if tr is None:
+        return web.json_response({
+            "traceEvents": [],
+            "displayTimeUnit": "ms",
+            "otherData": {"trace_enabled": False,
+                          "hint": "start the server with TRACE=1"},
+        })
+    out = tr.chrome_trace(last)
+    out["otherData"]["trace_enabled"] = True
+    return web.json_response(out)
 
-    POST /debug/trace {"seconds": 2}  (dump dir: JAX_TRACE_DIR env)
+
+async def handle_engine_debug(request: web.Request) -> web.Response:
+    """``GET /debug/engine`` — the engine flight recorder: the last N
+    loop iterations (batch composition, slot occupancy, KV pool
+    state), scheduling/fault events, and the last fatal-fault dump."""
+    engine = request.app[K_ENGINE]
+    flight = getattr(engine, "flight", None)
+    if flight is None:
+        raise web.HTTPNotFound(reason="engine has no flight recorder")
+    body = flight.snapshot()
+    body["dispatch_attribution"] = (
+        engine.dispatch_attribution()
+        if hasattr(engine, "dispatch_attribution") else {}
+    )
+    cdl = getattr(request.app[K_BATCHER], "_cdl", None)
+    if cdl is not None:
+        body["loop"] = {
+            "active": len(cdl.active),
+            "queued": cdl.queue.qsize(),
+            "prefilling": len(cdl._prefilling),
+            "chunk_dispatches": cdl.chunk_dispatches,
+            "prefill_dispatches": cdl.prefill_dispatches,
+            "preemptions": cdl.preemptions,
+        }
+    return web.json_response(body)
+
+
+async def handle_profile(request: web.Request) -> web.Response:
+    """On-demand XLA device profiling (SURVEY.md §5 tracing plan):
+    capture a jax.profiler trace for N seconds while traffic flows,
+    write a perfetto-compatible dump, return its path.
+
+    POST /debug/profile {"seconds": 2}  (dump dir: PROFILE_DIR knob)
     """
     try:
         body = await request.json()
     except Exception:
         body = {}
+    seconds = body.get("seconds", request.query.get("seconds", 2.0))
     try:
-        seconds = float(body.get("seconds", 2.0))
+        seconds = float(seconds)
     except (TypeError, ValueError):
         raise web.HTTPBadRequest(reason='"seconds" must be a number')
     if not (0.0 < seconds <= 30.0):  # also rejects NaN
         raise web.HTTPBadRequest(reason='"seconds" must be in (0, 30]')
-    # The dump location is server-owned (JAX_TRACE_DIR env), never
-    # client-controlled — this endpoint must not become an
-    # arbitrary-path file-write primitive.
-    trace_dir = os.environ.get("JAX_TRACE_DIR", "/tmp/jax-trace")
+    # The dump location is server-owned (PROFILE_DIR knob, legacy
+    # JAX_TRACE_DIR fallback), never client-controlled — this endpoint
+    # must not become an arbitrary-path file-write primitive.
+    trace_dir = (
+        getattr(request.app[K_CFG], "profile_dir", None)
+        or os.environ.get("PROFILE_DIR")
+        or os.environ.get("JAX_TRACE_DIR", "/tmp/jax-trace")
+    )
     if request.app[K_STATE]["tracing"]:
-        raise web.HTTPConflict(reason="a trace is already running")
+        raise web.HTTPConflict(reason="a profile capture is already running")
     request.app[K_STATE]["tracing"] = True
     import jax
 

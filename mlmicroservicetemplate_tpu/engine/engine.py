@@ -29,7 +29,7 @@ import numpy as np
 
 from ..models.registry import KIND_IMAGE, KIND_SEQ2SEQ, KIND_TEXT, ModelBundle
 from ..parallel import ReplicaSet, make_mesh
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 log = logging.getLogger(__name__)
 
@@ -59,6 +59,23 @@ class InferenceEngine:
         # HERE — at startup, before readiness — not on the Nth dispatch.
         from .faults import FaultInjector, Watchdog
 
+        # Observability (utils/tracing.py): TRACE=1 installs the
+        # process span tracer (never torn down here — a second engine
+        # without the knob must not disable the first's tracing); the
+        # flight recorder rides on the engine regardless so the loop's
+        # last iterations are always available for a fault post-mortem.
+        if getattr(cfg, "trace", False) and tracing.tracer() is None:
+            tracing.configure(True, int(getattr(cfg, "trace_ring", 4096)))
+        self.flight = tracing.FlightRecorder(
+            int(getattr(cfg, "flight_ring", 256))
+        )
+        # Per-site host-dispatch accounting (always on — two clock
+        # reads per dispatch): {site: [count, host_seconds,
+        # device_seconds]} where the device half only accumulates under
+        # TRACE=1 (it costs a block_until_ready).  bench.py records
+        # this split so "relay RTT dominates" is machine-checked.
+        self.dispatch_stats: dict[str, list] = {}
+        self._dispatch_stats_lock = threading.Lock()
         self.faults = FaultInjector.from_spec(
             getattr(cfg, "fault_spec", None),
             int(getattr(cfg, "fault_seed", 0) or 0),
@@ -69,6 +86,7 @@ class InferenceEngine:
             retries=int(getattr(cfg, "dispatch_retries", 2)),
             backoff_s=float(getattr(cfg, "dispatch_backoff_s", 0.05)),
             injector=self.faults,
+            recorder=self.flight,
         )
         if replicas is not None:
             self.replicas = replicas
@@ -623,8 +641,62 @@ class InferenceEngine:
         """Run one device-dispatch callable under the fault injector
         and the watchdog (deadline + transient retry).  Every guarded
         callable is functional — jitted calls and fetches with no
-        donation — so a retry is token-identical by construction."""
-        return self.watchdog.run(site, fn)
+        donation — so a retry is token-identical by construction.
+
+        Attribution: host submit→return time always feeds
+        ``dispatch_host_seconds{site}`` and the per-site stats bench.py
+        records.  Under TRACE=1 the result is additionally
+        ``block_until_ready``'d to measure the device half — the
+        host-vs-device split per site — at the documented cost of
+        serializing the dispatch pipeline (attribution mode)."""
+        tr = tracing.tracer()
+        if tr is None:
+            t0 = time.perf_counter()
+            out = self.watchdog.run(site, fn)
+            self._note_dispatch(site, time.perf_counter() - t0, None)
+            return out
+        with tr.span(f"dispatch:{site}", cat="dispatch") as sp:
+            t0 = time.perf_counter()
+            out = self.watchdog.run(site, fn)
+            host_s = time.perf_counter() - t0
+            device_s = None
+            try:
+                import jax
+
+                jax.block_until_ready(out)
+                device_s = time.perf_counter() - t0 - host_s
+            except Exception:
+                pass  # non-array results (already-fetched numpy): host-only
+            sp.set(host_ms=round(host_s * 1e3, 3))
+            if device_s is not None:
+                sp.set(device_ms=round(device_s * 1e3, 3))
+            self._note_dispatch(site, host_s, device_s)
+        return out
+
+    def _note_dispatch(self, site: str, host_s: float,
+                       device_s: float | None) -> None:
+        metrics.DISPATCH_HOST.labels(self.bundle.name, site).observe(host_s)
+        with self._dispatch_stats_lock:
+            st = self.dispatch_stats.setdefault(site, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += host_s
+            if device_s is not None:
+                st[2] += device_s
+
+    def dispatch_attribution(self) -> dict:
+        """Per-site dispatch accounting for the BENCH payload:
+        ``{site: {count, host_s, host_ms_avg, device_s}}`` — device_s
+        stays 0.0 unless a TRACE=1 window measured it."""
+        out = {}
+        with self._dispatch_stats_lock:
+            for site, (n, host, dev) in sorted(self.dispatch_stats.items()):
+                out[site] = {
+                    "count": n,
+                    "host_s": round(host, 4),
+                    "host_ms_avg": round(host / n * 1e3, 3) if n else 0.0,
+                    "device_s": round(dev, 4),
+                }
+        return out
 
     def fault_point(self, site: str) -> None:
         """Bare injection point for non-dispatch boundaries (e.g. the

@@ -212,12 +212,16 @@ class Watchdog:
     up to ``retries`` times with capped exponential backoff."""
 
     def __init__(self, model: str, timeout_s: float = 0.0, retries: int = 0,
-                 backoff_s: float = 0.05, injector: FaultInjector | None = None):
+                 backoff_s: float = 0.05, injector: FaultInjector | None = None,
+                 recorder=None):
         self.model = model
         self.timeout_s = max(0.0, float(timeout_s))
         self.retries = max(0, int(retries))
         self.backoff_s = max(0.0, float(backoff_s))
         self.injector = injector
+        # Optional flight recorder (utils/tracing.FlightRecorder): the
+        # retry/timeout events land in the engine post-mortem ring.
+        self.recorder = recorder
         self._passthrough = (
             self.injector is None and self.timeout_s <= 0 and self.retries <= 0
         )
@@ -234,6 +238,11 @@ class Watchdog:
                     metrics.DISPATCH_RETRIES.labels(
                         self.model, type(e).__name__
                     ).inc()
+                    if self.recorder is not None:
+                        self.recorder.event(
+                            "dispatch_retry", site=site, attempt=attempt + 1,
+                            error=f"{type(e).__name__}: {e}",
+                        )
                     time.sleep(min(self.backoff_s * (2 ** attempt), 2.0))
                     attempt += 1
                     continue
@@ -264,6 +273,10 @@ class Watchdog:
         t.start()
         if not done.wait(self.timeout_s):
             metrics.DISPATCH_TIMEOUTS.labels(self.model).inc()
+            if self.recorder is not None:
+                self.recorder.event(
+                    "dispatch_timeout", site=site, timeout_s=self.timeout_s
+                )
             raise DispatchTimeoutError(
                 f"{site} dispatch exceeded DISPATCH_TIMEOUT_S="
                 f"{self.timeout_s}s"

@@ -57,7 +57,7 @@ from typing import Any, AsyncIterator
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 log = logging.getLogger(__name__)
 
@@ -96,6 +96,7 @@ class _Stream:
         "budget", "klass", "deadline", "started", "kv", "kv_held",
         "skip", "tokens", "preempted", "t_in", "_removed",
         "blocks", "s_base", "s_lo", "shared_ids",
+        "rid", "t_queued", "t_emit",
     )
 
     # Admission-ledger marker: paged mode accounts streams via the
@@ -135,6 +136,13 @@ class _Stream:
         self.s_base = 0
         self.s_lo = 0
         self.shared_ids: list[int] = []
+        # Observability: the request id (span/log correlation key —
+        # the API stamps it on the feats dict), when this stream was
+        # last (re-)queued (queue-wait span start) and when its last
+        # chunk was delivered (stream_tbt_seconds cadence).
+        self.rid = str(feats.get("request_id") or "")
+        self.t_queued = self.t_in
+        self.t_emit = 0.0
 
     def emit(self, item: Any) -> None:
         try:
@@ -399,6 +407,12 @@ class ContinuousDecodeLoop:
         # scales with the LONGEST stream, not the stream count).
         self.prefill_dispatches = 0
         self.chunk_dispatches = 0
+        # Flight recorder (utils/tracing.py): the engine owns the ring;
+        # duck-typed test engines without one record nowhere.  The
+        # pacer's hold/grant decisions land in the same ring.
+        self._flight = getattr(engine, "flight", None)
+        if self.prefill_chunk:
+            self._pacer.recorder = self._flight
 
     # ------------------------------------------------------------------
     # event-loop side
@@ -431,33 +445,40 @@ class ContinuousDecodeLoop:
         st = _Stream(
             feats, asyncio.get_running_loop(), self.engine.budget_for(feats)
         )
-        if adm is not None:
-            klass, deadline = adm.classify(feats)
-            try:
-                klass, kv = adm.admit(feats, klass)
-            except QueueFullError as e:
-                if e.retry_after_s is None:
-                    e.retry_after_s = self._retry_after_s()
-                self._shed(e.reason)
-                raise
-            st.klass, st.deadline, st.kv = klass, deadline, kv
-        total = self._admitted + int(self.external_active())
-        if total >= self.max_streams + self.max_stream_queue:
-            victim = self.queue.evict_for(st)
-            if victim is None:
+        tr = tracing.tracer()
+        sp = tracing.NOOP if tr is None else tr.span(
+            "admission", cat="sched", rid=st.rid
+        )
+        with sp:
+            if adm is not None:
+                klass, deadline = adm.classify(feats)
+                try:
+                    klass, kv = adm.admit(feats, klass)
+                except QueueFullError as e:
+                    if e.retry_after_s is None:
+                        e.retry_after_s = self._retry_after_s()
+                    self._shed(e.reason)
+                    raise
+                st.klass, st.deadline, st.kv = klass, deadline, kv
+                sp.set(klass=st.klass, kv=st.kv)
+            total = self._admitted + int(self.external_active())
+            if total >= self.max_streams + self.max_stream_queue:
+                victim = self.queue.evict_for(st)
+                if victim is None:
+                    self._shed("queue_full")
+                    raise QueueFullError(
+                        f"{total} streams active >= max_streams="
+                        f"{self.max_streams}+{self.max_stream_queue} queued",
+                        retry_after_s=self._retry_after_s(),
+                    )
                 self._shed("queue_full")
-                raise QueueFullError(
-                    f"{total} streams active >= max_streams="
-                    f"{self.max_streams}+{self.max_stream_queue} queued",
+                self._finish(victim, QueueFullError(
+                    "shed for higher-priority stream",
                     retry_after_s=self._retry_after_s(),
-                )
-            self._shed("queue_full")
-            self._finish(victim, QueueFullError(
-                "shed for higher-priority stream",
-                retry_after_s=self._retry_after_s(),
-            ))
-        self._admitted += 1
-        self.queue.put(st, force=True)  # bound enforced just above
+                ))
+            self._admitted += 1
+            st.t_queued = time.monotonic()
+            self.queue.put(st, force=True)  # bound enforced just above
         self._ensure_thread()
 
         async def gen():
@@ -504,6 +525,16 @@ class ContinuousDecodeLoop:
             if self.admission is not None:
                 self.admission.release(st)
             dt = time.monotonic() - st.t_in
+            tr = tracing.tracer()
+            if tr is not None:
+                # The whole stream's lifetime (submit → release), the
+                # parent interval its queue-wait/prefill/decode spans
+                # tile — the per-request view of where the time went.
+                tr.add(
+                    "stream", cat="sched", rid=st.rid, t0=st.t_in, dur=dt,
+                    produced=st.produced, klass=st.klass,
+                    preempted=st.preempted,
+                )
             self._stream_ewma_s = 0.8 * self._stream_ewma_s + 0.2 * dt
             try:
                 st.loop.call_soon_threadsafe(self._dec_admitted)
@@ -515,6 +546,8 @@ class ContinuousDecodeLoop:
 
     def _shed(self, reason: str) -> None:
         metrics.SHED.labels(self.engine.bundle.name, reason).inc()
+        if self._flight is not None:
+            self._flight.event("shed", reason=reason)
 
     def _retry_after_s(self) -> float:
         est = (self._admitted + 1) * self._stream_ewma_s / max(
@@ -526,6 +559,15 @@ class ContinuousDecodeLoop:
         return self.admission is None or self.admission.fits(st)
 
     def _reserve(self, st: _Stream) -> None:
+        tr = tracing.tracer()
+        if tr is not None:
+            # The stream just left the wait queue: its queue-wait
+            # interval is [t_queued, now] (re-stamped on every
+            # checkpoint requeue, so resumes get their own span).
+            tr.add(
+                "queue_wait", cat="sched", rid=st.rid, t0=st.t_queued,
+                klass=st.klass, resumed=bool(st.started),
+            )
         if self.admission is not None:
             self.admission.reserve(st)
 
@@ -726,6 +768,7 @@ class ContinuousDecodeLoop:
                     # Waiters exist but none fit the KV budget (no
                     # admission, no work in flight): poll, don't spin.
                     time.sleep(0.01)
+                self._record_iteration()
             except Exception as e:
                 if self._recover(e):
                     continue
@@ -777,6 +820,35 @@ class ContinuousDecodeLoop:
             if st is not None:
                 st.emit(StreamClosedError("server stopping"))
             self._free_slot(slot)
+
+    def _record_iteration(self) -> None:
+        """One flight-recorder frame per non-idle loop iteration: batch
+        composition, slot occupancy, queue depths, KV pool state."""
+        fl = self._flight
+        if fl is None or not fl.size:
+            return
+        if not (self.active or self._prefilling or self._inflight_chunks):
+            return
+        rec = dict(
+            active=len(self.active),
+            free_slots=len(self.free),
+            queued=self.queue.qsize(),
+            prefilling=len(self._prefilling),
+            inflight_chunks=len(self._inflight_chunks),
+            chunk_dispatches=self.chunk_dispatches,
+            prefill_dispatches=self.prefill_dispatches,
+            slots={
+                str(slot): {
+                    "rid": st.rid, "klass": st.klass,
+                    "produced": st.produced, "budget": st.budget,
+                }
+                for slot, st in self.active.items()
+            },
+        )
+        if self.paged:
+            rec["pool_free_blocks"] = self.pool.free_blocks
+            rec["pool_used_blocks"] = self.pool.used_blocks
+        fl.record_iteration(**rec)
 
     def _expire_queued(self) -> None:
         """Fail every queued stream whose deadline passed while it
@@ -834,8 +906,22 @@ class ContinuousDecodeLoop:
         spent."""
         sup = self.supervisor
         if sup is None or not sup.allow_restart():
+            # Unrecoverable (no supervisor, or the budget is spent and
+            # the supervisor just dumped): leave a post-mortem either
+            # way — the caller error-terminates every stream next.
+            if sup is None and self._flight is not None:
+                self._flight.dump(
+                    f"unsupervised loop fault: {type(exc).__name__}: {exc}"
+                )
             return False
         eng = self.engine
+        # Dump BEFORE the rebuild mutates the rings' subject: the
+        # post-mortem must show the iterations that led here.
+        if self._flight is not None:
+            self._flight.dump(
+                f"fatal fault, supervised restart {sup.restarts}/"
+                f"{sup.max_restarts}: {type(exc).__name__}: {exc}"
+            )
         log.warning(
             "decode loop fault (%s: %s); supervised engine restart %d/%d",
             type(exc).__name__, exc, sup.restarts, sup.max_restarts,
@@ -936,6 +1022,8 @@ class ContinuousDecodeLoop:
             self._requeue_preempted(st)
             self.preemptions += 1
             metrics.PREEMPTIONS.labels(self.engine.bundle.name).inc()
+            if self._flight is not None:
+                self._flight.event("preempt", rid=st.rid, slot=slot)
             n += 1
         if n:
             # The vacated slots must go to the interactive waiters, not
@@ -996,6 +1084,12 @@ class ContinuousDecodeLoop:
         st.s_lo = st.s_base = 0
         if self.admission is not None:
             st.kv = self.admission.kv_bytes_for_resume(st.feats)
+        if self._flight is not None:
+            self._flight.event(
+                "checkpoint_requeue", rid=st.rid, klass=st.klass,
+                budget=st.budget, skip=st.skip, preempted=st.preempted,
+            )
+        st.t_queued = time.monotonic()
         self.queue.put(st, force=True)
 
     def _emit_tokens(self, st: _Stream, chunk) -> None:
@@ -1011,6 +1105,15 @@ class ContinuousDecodeLoop:
             st.tokens.extend(int(t) for t in arr.tolist())
             st.emit(arr)
             metrics.TOKENS.labels(self.engine.bundle.name).inc(int(arr.size))
+            # Inter-chunk delivery cadence (stream_tbt_seconds): the
+            # gap since this stream's PREVIOUS chunk — the first chunk
+            # is TTFT's business, not TBT's.
+            now = time.monotonic()
+            if st.t_emit:
+                metrics.TBT.labels(self.engine.bundle.name).observe(
+                    now - st.t_emit
+                )
+            st.t_emit = now
 
     # -- admission -----------------------------------------------------
 
@@ -1354,6 +1457,10 @@ class ContinuousDecodeLoop:
                 if slot is not None:
                     self.free.append(slot)
                 metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+                if self._flight is not None:
+                    self._flight.event(
+                        "kv_growth_stall", rid=st.rid, site="insert"
+                    )
                 if self.admission is not None:
                     self.admission.release(st)
                 self._requeue_preempted(st)
@@ -1593,40 +1700,47 @@ class ContinuousDecodeLoop:
         c = self.prefill_chunk
         start = job.consumed
         end = min(start + c, job.L)
-        ids_w = np.zeros((1, c), np.int32)
-        mask_w = np.zeros((1, c), np.int32)
-        ids_w[0, : end - start] = job.ids[start:end]
-        mask_w[0, : end - start] = 1
-        if self.paged:
-            # Fault-injection point, like decode growth: an injected
-            # OutOfBlocks exercises the mid-prefill checkpoint path.
-            eng.fault_point("grow")
-            self._reclaim_then_ensure(job.sb, end)
-            job.table_row[: len(job.sb.ids)] = job.sb.ids
-            if self._state is None:
-                self._build_empty_state()
-            with eng._lock:
-                self._state = eng.dispatch_guard(
-                    "prefill_chunk",
-                    lambda: self._paged_prefill_fn()(
-                        eng.params, self._state,
-                        jnp.asarray(job.table_row), ids_w, mask_w,
-                        np.int32(start),
-                    ),
-                )
-            if self.admission is not None:
-                self.admission.note_pool()
-        else:
-            with eng._lock:
-                job.state = eng.dispatch_guard(
-                    "prefill_chunk",
-                    lambda: self._prefill_fn()(
-                        eng.params, job.state, ids_w, mask_w, np.int32(start)
-                    ),
-                )
-        job.consumed = end
-        self.prefill_chunk_dispatches += 1
-        metrics.PREFILL_CHUNKS.labels(eng.bundle.name).inc()
+        tr = tracing.tracer()
+        sp = tracing.NOOP if tr is None else tr.span(
+            "prefill_window", cat="engine", rid=job.st.rid,
+            start=start, end=end, total=job.L, paged=self.paged,
+        )
+        with sp:
+            ids_w = np.zeros((1, c), np.int32)
+            mask_w = np.zeros((1, c), np.int32)
+            ids_w[0, : end - start] = job.ids[start:end]
+            mask_w[0, : end - start] = 1
+            if self.paged:
+                # Fault-injection point, like decode growth: an injected
+                # OutOfBlocks exercises the mid-prefill checkpoint path.
+                eng.fault_point("grow")
+                self._reclaim_then_ensure(job.sb, end)
+                job.table_row[: len(job.sb.ids)] = job.sb.ids
+                if self._state is None:
+                    self._build_empty_state()
+                with eng._lock:
+                    self._state = eng.dispatch_guard(
+                        "prefill_chunk",
+                        lambda: self._paged_prefill_fn()(
+                            eng.params, self._state,
+                            jnp.asarray(job.table_row), ids_w, mask_w,
+                            np.int32(start),
+                        ),
+                    )
+                if self.admission is not None:
+                    self.admission.note_pool()
+            else:
+                with eng._lock:
+                    job.state = eng.dispatch_guard(
+                        "prefill_chunk",
+                        lambda: self._prefill_fn()(
+                            eng.params, job.state, ids_w, mask_w,
+                            np.int32(start)
+                        ),
+                    )
+            job.consumed = end
+            self.prefill_chunk_dispatches += 1
+            metrics.PREFILL_CHUNKS.labels(eng.bundle.name).inc()
 
     def _handoff_job(self, job: _PrefillJob) -> bool:
         """Prompt exhausted: flip the stream live in a slot — the
@@ -1783,6 +1897,10 @@ class ContinuousDecodeLoop:
                 # token-identical restart when blocks free up — the
                 # prefill mirror of _grow_for_dispatch's preemption.
                 metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+                if self._flight is not None:
+                    self._flight.event(
+                        "kv_growth_stall", rid=job.st.rid, site="prefill"
+                    )
                 self._prefilling.remove(job)
                 self._checkpoint_job(job)
                 continue
@@ -2331,6 +2449,10 @@ class ContinuousDecodeLoop:
                     fresh = st.blocks.ids[-1:]  # table refresh below
                 except OutOfBlocks:
                     metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+                    if self._flight is not None:
+                        self._flight.event(
+                            "kv_growth_stall", rid=st.rid, site="grow"
+                        )
                     self.active.pop(slot)
                     self.sampled_slots.discard(slot)
                     self.free.append(slot)
@@ -2349,6 +2471,16 @@ class ContinuousDecodeLoop:
 
     def _dispatch_chunk(self) -> None:
         eng = self.engine
+        tr = tracing.tracer()
+        sp = tracing.NOOP if tr is None else tr.span(
+            "decode_chunk", cat="engine", n_streams=len(self.active),
+            streams=[st.rid for st in self.active.values()],
+            paged=self.paged,
+        )
+        with sp:
+            self._dispatch_chunk_inner(eng)
+
+    def _dispatch_chunk_inner(self, eng) -> None:
         if self.paged:
             self._grow_for_dispatch()
             if not self.active:  # every row checkpointed on a dry pool

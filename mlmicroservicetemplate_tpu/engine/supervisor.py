@@ -21,12 +21,18 @@ import threading
 class Supervisor:
     """Bounded-restart policy shared by the decode loop and /readyz."""
 
-    def __init__(self, cfg=None, max_restarts: int | None = None):
+    def __init__(self, cfg=None, max_restarts: int | None = None,
+                 recorder=None):
         if max_restarts is None:
             max_restarts = int(getattr(cfg, "engine_restarts_max", 3) or 0)
         self.max_restarts = max(0, int(max_restarts))
         self.restarts = 0
         self.failed = False
+        # Optional flight recorder (utils/tracing.FlightRecorder): the
+        # ring dumps the moment a restart is granted or refused, so
+        # the post-mortem shows the iterations that LED to the fault —
+        # not whatever ran after recovery overwrote them.
+        self.recorder = recorder
         self._lock = threading.Lock()
 
     def allow_restart(self) -> bool:
@@ -34,9 +40,20 @@ class Supervisor:
         once it is exhausted."""
         with self._lock:
             if self.failed or self.restarts >= self.max_restarts:
+                first = not self.failed
                 self.failed = True
+                if self.recorder is not None and first:
+                    self.recorder.dump(
+                        "engine restart budget exhausted "
+                        f"({self.restarts}/{self.max_restarts})"
+                    )
                 return False
             self.restarts += 1
+            if self.recorder is not None:
+                self.recorder.event(
+                    "engine_restart", n=self.restarts,
+                    max=self.max_restarts,
+                )
             return True
 
     def stats(self) -> dict:

@@ -29,7 +29,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .policy import BATCH, CLASSES, INTERACTIVE, QueueFullError
 
 
@@ -62,6 +62,17 @@ class AdmissionController:
         # pool hasn't claimed.
         self.paged = bool(getattr(engine, "paged_kv", False))
         self.pool = getattr(engine, "kv_pool", None)
+        # Flight recorder (utils/tracing.py, engine-owned): admission's
+        # down-class decisions land in the engine post-mortem ring.
+        self.recorder = getattr(engine, "flight", None)
+
+    def _note_downclass(self, feats: dict, why: str) -> None:
+        rid = str(feats.get("request_id") or "")
+        tr = tracing.tracer()
+        if tr is not None:
+            tr.instant("downclass", cat="sched", rid=rid, why=why)
+        if self.recorder is not None:
+            self.recorder.event("downclass", rid=rid, why=why)
 
     def _pool_bytes(self) -> int:
         return self.pool.used_bytes if (self.paged and self.pool) else 0
@@ -139,6 +150,7 @@ class AdmissionController:
             if self.pool.free_blocks < initial and klass == INTERACTIVE:
                 # Transient pressure: wait it out in the lower class.
                 klass = BATCH
+                self._note_downclass(feats, "pool_pressure")
             return klass, initial * self.pool.block_bytes
         kv = self.kv_bytes(feats)
         if self.kv_budget_bytes:
@@ -154,6 +166,7 @@ class AdmissionController:
                 # Transient overcommit: wait out the pressure in the
                 # lower class instead of failing at slot-insert.
                 klass = BATCH
+                self._note_downclass(feats, "kv_overcommit")
         return klass, kv
 
     def fits(self, item) -> bool:

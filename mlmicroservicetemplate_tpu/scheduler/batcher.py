@@ -42,7 +42,7 @@ from typing import Any, AsyncIterator
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .admission import AdmissionController
 from .policy import (  # noqa: F401  (QueueFullError re-exported here)
     BATCH,
@@ -141,7 +141,11 @@ class Batcher:
             if getattr(cfg, "supervise", True):
                 from ..engine.supervisor import Supervisor
 
-                self.supervisor = Supervisor(cfg)
+                # The supervisor dumps the engine flight recorder the
+                # moment it grants (or refuses) a restart.
+                self.supervisor = Supervisor(
+                    cfg, recorder=getattr(engine, "flight", None)
+                )
                 self._cdl.supervisor = self.supervisor
 
     # ------------------------------------------------------------------
@@ -201,6 +205,9 @@ class Batcher:
 
     def _shed(self, reason: str) -> None:
         metrics.SHED.labels(self.model, reason).inc()
+        fl = getattr(self.engine, "flight", None)
+        if fl is not None:
+            fl.event("shed", reason=reason, path="batch")
 
     def retry_after_s(self, streams: bool = False) -> float:
         """Client guidance on 503: expected seconds until capacity,
@@ -340,6 +347,7 @@ class Batcher:
         cancelled = threading.Event()
 
         def pump():
+            t_prev = 0.0
             try:
                 gen = self.engine.generate_stream(feats)
                 try:
@@ -357,6 +365,14 @@ class Batcher:
                             break
                         loop.call_soon_threadsafe(chunks.put_nowait, chunk)
                         metrics.TOKENS.labels(self.model).inc(int(chunk.size))
+                        # Same TBT series the continuous loop feeds:
+                        # inter-chunk cadence after the first chunk.
+                        t_now = time.monotonic()
+                        if t_prev:
+                            metrics.TBT.labels(self.model).observe(
+                                t_now - t_prev
+                            )
+                        t_prev = t_now
                 finally:
                     gen.close()
                 loop.call_soon_threadsafe(chunks.put_nowait, _END)
@@ -501,8 +517,15 @@ class Batcher:
         loop = asyncio.get_running_loop()
         now = time.monotonic()
         feats = [item.feats for item in batch]
+        tr = tracing.tracer()
         for item in batch:
             metrics.QUEUE_WAIT.labels(self.model).observe(now - item.t_in)
+            if tr is not None:
+                tr.add(
+                    "queue_wait", cat="sched",
+                    rid=str(item.feats.get("request_id") or ""),
+                    t0=item.t_in, dur=now - item.t_in, klass=item.klass,
+                )
         metrics.BATCH_SIZE.labels(self.model).observe(len(batch))
         t0 = time.monotonic()
         try:

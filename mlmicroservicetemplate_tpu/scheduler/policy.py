@@ -80,6 +80,11 @@ class PrefillPacer:
     def __init__(self, weight: int = 4):
         self.weight = max(1, int(weight))
         self._held = 0
+        # Optional flight recorder (utils/tracing.FlightRecorder, wired
+        # by the decode loop): every hold/grant decision on batch-class
+        # prefill is an event in the engine post-mortem ring — "why
+        # didn't my batch prompt advance" answers itself.
+        self.recorder = None
 
     def allow(self, job_klass: str, interactive_active: bool) -> bool:
         """May a ``job_klass`` prefill window dispatch at this chunk
@@ -89,7 +94,16 @@ class PrefillPacer:
         self._held += 1
         if self._held >= self.weight:
             self._held = 0
+            if self.recorder is not None:
+                self.recorder.event(
+                    "pacer_grant", klass=job_klass, weight=self.weight
+                )
             return True
+        if self.recorder is not None:
+            self.recorder.event(
+                "pacer_hold", klass=job_klass, held=self._held,
+                weight=self.weight,
+            )
         return False
 
 

@@ -50,6 +50,16 @@ def build_service(overrides: dict | None = None):
         level=getattr(logging, cfg.log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    from .utils import tracing
+
+    if cfg.log_format == "json":
+        # One JSON object per line, request_id-correlated with spans
+        # and HTTP error bodies (utils/tracing.JsonLogFormatter).
+        for h in logging.getLogger().handlers:
+            h.setFormatter(tracing.JsonLogFormatter())
+    # TRACE=1 installs the process span tracer before any engine or
+    # request work so startup dispatches are attributable too.
+    tracing.configure(cfg.trace, cfg.trace_ring)
 
     # Multi-host rendezvous (JAX_COORDINATOR/NUM_PROCESSES/PROCESS_ID;
     # no-op single-host) — must precede apply_device_env, whose backend

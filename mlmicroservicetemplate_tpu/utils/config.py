@@ -265,6 +265,27 @@ class ServiceConfig(BaseModel):
 
     # Observability.
     log_level: str = "INFO"
+    # Log line shape: "text" (the classic formatter) or "json" (one
+    # structured object per line, request_id-correlated with spans and
+    # HTTP error bodies — utils/tracing.JsonLogFormatter).
+    log_format: str = "text"
+    # Request-level span tracing (utils/tracing.py): spans at the
+    # request / admission / queue-wait / prefill-window / decode-chunk
+    # / dispatch-site seams, exported as Chrome trace-event JSON at
+    # GET /debug/trace.  Off = zero overhead (no span objects on the
+    # hot path).  ON additionally block_until_ready's each dispatch to
+    # split host vs device time — an attribution mode that serializes
+    # the chunk pipeline; see docs/observability.md.
+    trace: bool = False
+    # Completed spans kept in the trace ring.
+    trace_ring: int = 4096
+    # Engine flight recorder ring: loop iterations + scheduling/fault
+    # events kept for GET /debug/engine and the automatic dump on
+    # fatal faults.  0 disables recording (dump still answers, empty).
+    flight_ring: int = 256
+    # Directory for on-demand jax.profiler device traces
+    # (POST /debug/profile); None = $PROFILE_DIR or /tmp/jax-trace.
+    profile_dir: str | None = None
 
     @field_validator("quantize")
     @classmethod
@@ -387,6 +408,21 @@ class ServiceConfig(BaseModel):
             raise ValueError("DISPATCH_RETRIES/ENGINE_RESTARTS_MAX must be >= 0")
         return v
 
+    @field_validator("log_format")
+    @classmethod
+    def _check_log_format(cls, v: str) -> str:
+        v = v.lower()
+        if v not in ("text", "json"):
+            raise ValueError(f"LOG_FORMAT must be 'text' or 'json', got {v!r}")
+        return v
+
+    @field_validator("trace_ring", "flight_ring")
+    @classmethod
+    def _check_ring(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError("TRACE_RING/FLIGHT_RING must be >= 0")
+        return v
+
 
 def _env(name: str, default: str | None = None) -> str | None:
     v = os.environ.get(name)
@@ -407,7 +443,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, PREFILL_CHUNK,
       PREFILL_BUDGET, PREFILL_MAX_PROMPT, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
-      ENGINE_RESTARTS_MAX, SUPERVISE.
+      ENGINE_RESTARTS_MAX, SUPERVISE, TRACE, TRACE_RING, FLIGHT_RING,
+      PROFILE_DIR, LOG_FORMAT.
     """
     e = dict(os.environ)
     if env:
@@ -432,6 +469,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "spec_decode": "SPEC_DECODE",
         "priority_default": "PRIORITY_DEFAULT",
         "fault_spec": "FAULT_SPEC",
+        "log_format": "LOG_FORMAT",
+        "profile_dir": "PROFILE_DIR",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -460,6 +499,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "fault_seed": "FAULT_SEED",
         "dispatch_retries": "DISPATCH_RETRIES",
         "engine_restarts_max": "ENGINE_RESTARTS_MAX",
+        "trace_ring": "TRACE_RING",
+        "flight_ring": "FLIGHT_RING",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -490,6 +531,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("SUPERVISE")
     if v is not None:
         kwargs["supervise"] = v.lower() not in ("0", "false", "no")
+    v = get("TRACE")
+    if v is not None:
+        kwargs["trace"] = v.lower() not in ("0", "false", "no")
     # Comma-separated bucket overrides, e.g. BATCH_BUCKETS=1,8,32 — used
     # to bound warmup compile time when only some shapes will be served.
     for field, var in (("batch_buckets", "BATCH_BUCKETS"), ("seq_buckets", "SEQ_BUCKETS")):

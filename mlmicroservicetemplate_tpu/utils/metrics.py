@@ -176,6 +176,29 @@ KV_GROWTH_STALLS = Counter(
     "checkpointed and re-queued (resumes when blocks free up)",
     ["model"],
 )
+# Sub-millisecond buckets: dispatch submit→return and inter-token
+# cadence both sit well under 1 ms on direct-attached chips — the
+# whole point of these two series is separating that regime from the
+# ~100 ms relay RTT regime.
+_FINE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+DISPATCH_HOST = Histogram(
+    "dispatch_host_seconds",
+    "Host time one guarded device dispatch spent from submit to "
+    "return, by dispatch site (prefill | prefill_chunk | chunk | "
+    "fetch | batch) — the host-side half of the host-vs-device "
+    "attribution split (TRACE=1 spans carry the device half)",
+    ["model", "site"], buckets=_FINE_BUCKETS,
+)
+TBT = Histogram(
+    "stream_tbt_seconds",
+    "Streaming inter-chunk delivery gap (time between consecutive "
+    "token-chunk deliveries to one stream after its first chunk) — "
+    "the decode-cadence series the chunked-prefill A/B judges",
+    ["model"], buckets=_FINE_BUCKETS,
+)
 
 
 def render() -> tuple[bytes, str]:

@@ -67,6 +67,20 @@ else
     echo "== chunked-prefill smoke skipped (PREFILL_SMOKE=0) =="
 fi
 
+# Observability smoke: the full HTTP service under TRACE=1 with a
+# transient fault injected, then /debug/trace (schema-valid Perfetto
+# JSON with every stage span) and /debug/engine (flight recorder with
+# the retry event) are validated.  OBS_SMOKE=0 skips.
+if [ "${OBS_SMOKE:-1}" != "0" ]; then
+    echo "== observability smoke (TRACE=1 + chunk:transient@2) =="
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        OBS_SMOKE_SPEC="${OBS_SMOKE_SPEC:-chunk:transient@2}" \
+        python -m pytest tests/test_tracing.py::test_observability_smoke \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== observability smoke skipped (OBS_SMOKE=0) =="
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

