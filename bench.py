@@ -139,6 +139,28 @@ async def bench_serving() -> "tuple[dict, object]":
             }
         import jax
 
+        # Decode-fusion accounting (round 12): host syncs per generated
+        # token — the quantity DECODE_WINDOW divides — plus the window
+        # stats, recorded in every BENCH json (zero/None on the
+        # non-generative resnet headline, populated when MODEL_NAME is
+        # a decoder family).
+        attrs = engine.dispatch_attribution()
+        syncs = sum(
+            attrs.get(site, {}).get("count", 0) for site in ("chunk", "fetch")
+        )
+        cdl = getattr(batcher, "_cdl", None)
+        tokens = getattr(cdl, "tokens_emitted", 0) if cdl is not None else 0
+        decode_fusion = {
+            "host_syncs": syncs,
+            "tokens": tokens,
+            "host_syncs_per_token": round(syncs / tokens, 4) if tokens else None,
+            "window_cap": getattr(cdl, "decode_window", 1) if cdl else 1,
+            "window_dispatches": getattr(cdl, "window_dispatches", 0) if cdl else 0,
+            "window_chunks": getattr(cdl, "window_chunks", 0) if cdl else 0,
+            "window_early_exits": getattr(cdl, "window_early_exits", 0) if cdl else 0,
+            "chain_depth": getattr(cdl, "chain_depth", None) if cdl else None,
+        }
+
         return {
             "p50_ms": round(statistics.median(lats) * 1000, 3),
             "p99_ms": round(
@@ -155,6 +177,7 @@ async def bench_serving() -> "tuple[dict, object]":
             "backend": jax.default_backend(),
             "n_devices": engine.replicas.n_devices,
             "dispatch_attribution": attribution,
+            "decode_fusion": decode_fusion,
         }, engine
     finally:
         await client.close()

@@ -150,6 +150,16 @@ async def main() -> None:
             check=False,
         )
 
+    # Fused decode windows (round-12 tentpole): host syncs per token,
+    # tokens/s and decode TBT p99 vs DECODE_WINDOW ∈ {1, 2, 4, 8},
+    # plus the interactive-lane TBT guard under the auto governor.
+    # FUSION_AB=0 skips.
+    if os.environ.get("FUSION_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "decode_fusion_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

@@ -1127,6 +1127,29 @@ async def handle_status(request: web.Request) -> web.Response:
     if batcher.supervisor is not None:
         body["fault_tolerance"] = batcher.supervisor.stats()
     cdl = getattr(batcher, "_cdl", None)
+    if cdl is not None:
+        # Decode dispatch shape: the auto-tuned chunk-chain pipelining
+        # depth (STREAM_PIPELINE=0 picks it from measured RTT/compute
+        # at warmup — invisible until now) and the fused decode-window
+        # stats (DECODE_WINDOW; docs/decode-fusion.md).
+        body["decode"] = {
+            "chain_depth": cdl.chain_depth,
+            "chain_depth_auto": cdl._auto_depth,
+            "chunk_tokens": engine.chunk_tokens,
+            "window_cap": getattr(cdl, "decode_window", 1),
+            "last_window": getattr(cdl, "last_window", 1),
+            "window_dispatches": getattr(cdl, "window_dispatches", 0),
+            "window_chunks": getattr(cdl, "window_chunks", 0),
+            "window_early_exits": getattr(cdl, "window_early_exits", 0),
+            "chunk_dispatches": cdl.chunk_dispatches,
+            "tokens_emitted": getattr(cdl, "tokens_emitted", 0),
+            # Per-site host-sync counts (the quantity DECODE_WINDOW
+            # divides); the fusion A/B reads the chunk+fetch deltas.
+            "dispatch_counts": {
+                site: a["count"]
+                for site, a in engine.dispatch_attribution().items()
+            },
+        }
     if cdl is not None and getattr(cdl, "prefill_chunk", 0):
         body["prefill"] = {
             "chunk": cdl.prefill_chunk,

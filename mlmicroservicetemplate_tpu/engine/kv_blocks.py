@@ -171,6 +171,21 @@ class StreamBlocks:
         self.ids.extend(fresh)
         return fresh
 
+    def trim(self, n_tokens: int) -> list[int]:
+        """Return tail blocks past what ``n_tokens`` positions need —
+        the window-boundary reconcile for fused decode: blocks
+        pre-provisioned for chunks an early-exited window never ran go
+        back to the pool instead of riding the stream until it ends.
+        Never trims into the adopted CoW prefix.  Returns the freed
+        ids ([] when already exact)."""
+        keep = max(blocks_for(n_tokens, self.block_size), self.shared)
+        if keep >= len(self.ids):
+            return []
+        tail = self.ids[keep:]
+        self.ids = self.ids[:keep]
+        self.pool.free(tail)
+        return tail
+
     def release(self) -> None:
         if not self.released:
             self.released = True

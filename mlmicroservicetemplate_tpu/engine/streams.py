@@ -317,6 +317,46 @@ class ContinuousDecodeLoop:
             self._paged_insert = None
             self._gather_prefix_fns: dict[int, Any] = {}
             self._dispatched_steps: dict[int, int] = {}
+        # Fused decode windows (DECODE_WINDOW; docs/decode-fusion.md):
+        # up to W chunk scans fuse into ONE dispatch (lax.while_loop
+        # with on-device EOS early exit, models/window.py), so the
+        # host submits once, fetches once and reconciles once per W
+        # chunks — the direct attack on the round-11 attribution's
+        # host_share ≈ 1.0 at the chunk/fetch sites.  W is picked per
+        # dispatch by the governor (scheduler/policy.py): deep for
+        # batch-class / idle backfill, 1 whenever interactive streams
+        # are live or waiting (their TBT and the admission/preemption
+        # cadence bind at chunk boundaries).  1 = off, exactly the
+        # seed's per-chunk dispatch path.
+        self.decode_window = max(1, int(getattr(cfg, "decode_window", 1) or 1))
+        if self.decode_window > 1:
+            if self.spec:
+                raise ValueError(
+                    "DECODE_WINDOW>1 does not compose with SPEC_CONTINUOUS "
+                    "(spec rounds are their own fused dispatch shape)"
+                )
+            bundle = engine.bundle
+            if getattr(bundle, "window_fn", None) is None or (
+                self.paged and getattr(bundle, "paged_window_fn", None) is None
+            ):
+                raise ValueError(
+                    f"DECODE_WINDOW={self.decode_window} needs a window-"
+                    f"capable family (gpt2/llama); {bundle.name} decodes "
+                    "one chunk per dispatch"
+                )
+        from ..scheduler.policy import DecodeWindowGovernor
+
+        self._window_gov = DecodeWindowGovernor(
+            self.decode_window, bool(getattr(cfg, "decode_window_auto", True))
+        )
+        self._window_jit = None
+        self._paged_window_jit = None
+        # Window observability (/status.decode + bench window stats).
+        self.window_dispatches = 0
+        self.window_chunks = 0
+        self.window_early_exits = 0
+        self.last_window = 1
+        self.tokens_emitted = 0
         # SLA scheduling (scheduler/policy.py): the old unbounded
         # handoff Queue + instant reject past max_streams is now a
         # BOUNDED deadline-aware wait queue — up to ``max_stream_queue``
@@ -413,6 +453,8 @@ class ContinuousDecodeLoop:
         self._flight = getattr(engine, "flight", None)
         if self.prefill_chunk:
             self._pacer.recorder = self._flight
+        self._window_gov.recorder = self._flight
+        metrics.CHAIN_DEPTH.labels(engine.bundle.name).set(self.chain_depth)
 
     # ------------------------------------------------------------------
     # event-loop side
@@ -645,6 +687,11 @@ class ContinuousDecodeLoop:
                 # Stale waiters shed as fast 504s BEFORE any admission
                 # work — never prefill a request nobody is waiting for.
                 self._expire_queued()
+                # Already-landed in-flight results route NOW (paged):
+                # EOS'd rows' blocks return to the pool before this
+                # iteration's growth pass instead of after it, and the
+                # freed slots open admission capacity below.
+                self._deliver_ready()
                 if (
                     not self.active
                     and not self._inflight_chunks
@@ -837,6 +884,7 @@ class ContinuousDecodeLoop:
             inflight_chunks=len(self._inflight_chunks),
             chunk_dispatches=self.chunk_dispatches,
             prefill_dispatches=self.prefill_dispatches,
+            window=self.last_window,
             slots={
                 str(slot): {
                     "rid": st.rid, "klass": st.klass,
@@ -1104,6 +1152,7 @@ class ContinuousDecodeLoop:
         if arr.size:
             st.tokens.extend(int(t) for t in arr.tolist())
             st.emit(arr)
+            self.tokens_emitted += int(arr.size)
             metrics.TOKENS.labels(self.engine.bundle.name).inc(int(arr.size))
             # Inter-chunk delivery cadence (stream_tbt_seconds): the
             # gap since this stream's PREVIOUS chunk — the first chunk
@@ -1876,7 +1925,13 @@ class ContinuousDecodeLoop:
             self._prefilling.remove(job)
             if self._handoff_job(job):
                 advanced = True
-        budget = self.prefill_budget if live else (1 << 30)
+        # Window boundaries are up to last_window× rarer than chunk
+        # boundaries: scale the per-boundary budget so prefill keeps
+        # the same share of the interleave under deep fusion.
+        budget = (
+            self.prefill_budget * max(1, self.last_window)
+            if live else (1 << 30)
+        )
         jobs = sorted(
             [j for j in self._prefilling if not j.ready],
             key=lambda j: (
@@ -2408,28 +2463,85 @@ class ContinuousDecodeLoop:
 
     # -- decode --------------------------------------------------------
 
+    def _inflight_chunks_ahead(self) -> int:
+        """Upper bound on chunks the in-flight dispatches will deliver
+        (windows count their full cap — early exit only shortens)."""
+        return sum(w for _, _, w in self._inflight_chunks)
+
     def _work_remains(self) -> bool:
         """True while some active stream still needs tokens beyond
-        what the in-flight chunks will already deliver (``produced``
-        only advances at delivery, so count in-flight coverage)."""
-        ahead = len(self._inflight_chunks) * self.engine.chunk_tokens
+        what the in-flight dispatches will already deliver
+        (``produced`` only advances at delivery, so count in-flight
+        coverage — window dispatches cover up to W chunks each)."""
+        ahead = self._inflight_chunks_ahead() * self.engine.chunk_tokens
         return any(
             st.produced + ahead < st.budget for st in self.active.values()
         )
 
-    def _grow_for_dispatch(self) -> None:
-        """Block-by-block growth at the chunk boundary: every live
-        row's table must cover the positions the NEXT chunk will
-        write.  A row whose growth finds the pool dry — after
-        reclaiming prefix pins — is checkpointed and re-queued
-        (token-identical resume when blocks free), the paged
-        equivalent of vLLM's preempt-on-OOM; admission's worst-case
-        bound guarantees a stream running alone always fits, so this
-        terminates."""
+    def _pick_window(self) -> int:
+        """Fused-window depth for the NEXT dispatch: the governor's
+        class policy, clamped to the chunks any live stream still
+        needs beyond what is already in flight."""
+        if self.decode_window <= 1 or self.spec:
+            return 1
+        from ..scheduler.policy import INTERACTIVE
+
+        chunk = self.engine.chunk_tokens
+        ahead = self._inflight_chunks_ahead() * chunk
+        need = max(
+            (
+                st.budget - st.produced - ahead
+                for st in self.active.values()
+                if not st.cancelled.is_set()
+            ),
+            default=0,
+        )
+        interactive_live = any(
+            st.klass == INTERACTIVE and not st.cancelled.is_set()
+            for st in self.active.values()
+        )
+        interactive_waiting = self.queue.waiting(INTERACTIVE) > 0 or any(
+            j.st.klass == INTERACTIVE for j in self._prefilling
+        )
+        return self._window_gov.pick(
+            max_chunks=-(-need // chunk),
+            interactive_live=interactive_live,
+            interactive_waiting=interactive_waiting,
+        )
+
+    def _window_fn(self):
+        """Jitted fused-window executable (static n_steps/max_chunks/
+        sample — one executable per (W, sample) pair, W power-of-two
+        bounded by the governor)."""
+        import jax
+
+        if self.paged:
+            if self._paged_window_jit is None:
+                self._paged_window_jit = jax.jit(
+                    self.engine.bundle.paged_window_fn,
+                    static_argnums=(3, 4, 5),
+                )
+            return self._paged_window_jit
+        if self._window_jit is None:
+            self._window_jit = jax.jit(
+                self.engine.bundle.window_fn, static_argnums=(2, 3, 4)
+            )
+        return self._window_jit
+
+    def _grow_for_dispatch(self, n_chunks: int = 1) -> None:
+        """Block-by-block growth at the dispatch boundary: every live
+        row's table must cover the positions the NEXT ``n_chunks``
+        chunks will write (a fused window pre-provisions its whole
+        depth up front; the ledger reconciles at the window boundary).
+        A row whose growth finds the pool dry — after reclaiming
+        prefix pins — is checkpointed and re-queued (token-identical
+        resume when blocks free), the paged equivalent of vLLM's
+        preempt-on-OOM; admission's worst-case bound guarantees a
+        stream running alone always fits, so this terminates."""
         from .kv_blocks import OutOfBlocks
 
         eng = self.engine
-        chunk = eng.chunk_tokens
+        chunk = eng.chunk_tokens * max(1, int(n_chunks))
         grew = False
         for slot, st in list(self.active.items()):
             if st.cancelled.is_set() or st.blocks is None:
@@ -2471,18 +2583,32 @@ class ContinuousDecodeLoop:
 
     def _dispatch_chunk(self) -> None:
         eng = self.engine
+        w = self._pick_window()
+        self.last_window = w
         tr = tracing.tracer()
         sp = tracing.NOOP if tr is None else tr.span(
             "decode_chunk", cat="engine", n_streams=len(self.active),
             streams=[st.rid for st in self.active.values()],
-            paged=self.paged,
+            paged=self.paged, window=w,
         )
         with sp:
-            self._dispatch_chunk_inner(eng)
+            self._dispatch_chunk_inner(eng, w)
 
-    def _dispatch_chunk_inner(self, eng) -> None:
+    def _note_dispatched(self, entry) -> None:
+        eng = self.engine
+        self.chunk_dispatches += 1
+        metrics.STREAM_BATCH.labels(eng.bundle.name).observe(len(self.active))
+        w = entry[2]
+        metrics.DECODE_WINDOW_CHUNKS.labels(eng.bundle.name).observe(w)
+        if w > 1:
+            self.window_dispatches += 1
+        self._inflight_chunks.append(entry)
+
+    def _dispatch_chunk_inner(self, eng, w: int = 1) -> None:
         if self.paged:
-            self._grow_for_dispatch()
+            # A fused window pre-provisions blocks for its whole depth
+            # up front: one growth pass per window, not per chunk.
+            self._grow_for_dispatch(w)
             if not self.active:  # every row checkpointed on a dry pool
                 return
             use_sample = bool(self.sampled_slots)
@@ -2490,20 +2616,28 @@ class ContinuousDecodeLoop:
 
             with eng._lock:
                 table = jnp.asarray(self._table)
-                self._state, toks = eng.dispatch_guard(
-                    "chunk",
-                    lambda: self._paged_chunk_fn()(
-                        eng.params, self._state, table,
-                        eng.chunk_tokens, use_sample,
-                    ),
-                )
-                done = self._state.done
-                prefetch_to_host(toks, done)
-            self.chunk_dispatches += 1
-            metrics.STREAM_BATCH.labels(eng.bundle.name).observe(
-                len(self.active)
-            )
-            self._inflight_chunks.append((toks, done, dict(self.active)))
+                if w > 1:
+                    self._state, toks, hist, nc = eng.dispatch_guard(
+                        "chunk",
+                        lambda: self._window_fn()(
+                            eng.params, self._state, table,
+                            eng.chunk_tokens, w, use_sample,
+                        ),
+                    )
+                    prefetch_to_host(toks, hist, nc)
+                    entry = ((toks, hist, nc), dict(self.active), w)
+                else:
+                    self._state, toks = eng.dispatch_guard(
+                        "chunk",
+                        lambda: self._paged_chunk_fn()(
+                            eng.params, self._state, table,
+                            eng.chunk_tokens, use_sample,
+                        ),
+                    )
+                    done = self._state.done
+                    prefetch_to_host(toks, done)
+                    entry = ((toks, done), dict(self.active), 1)
+            self._note_dispatched(entry)
             return
         use_sample = bool(self.sampled_slots)
         with eng._lock:
@@ -2517,9 +2651,19 @@ class ContinuousDecodeLoop:
                         eng.spec_k, use_sample,
                     ),
                 )
-                toks = (out, ns)
                 done = self._state.base.done
                 prefetch_to_host(out, ns, done)
+                entry = (((out, ns), done), dict(self.active), 1)
+            elif w > 1:
+                self._state, toks, hist, nc = eng.dispatch_guard(
+                    "chunk",
+                    lambda: self._window_fn()(
+                        eng.params, self._state, eng.chunk_tokens, w,
+                        use_sample,
+                    ),
+                )
+                prefetch_to_host(toks, hist, nc)
+                entry = ((toks, hist, nc), dict(self.active), w)
             else:
                 self._state, toks = eng.dispatch_guard(
                     "chunk",
@@ -2533,23 +2677,32 @@ class ContinuousDecodeLoop:
                 # _deliver_oldest finds the data (mostly) already on
                 # this side of the wire.
                 prefetch_to_host(toks, done)
-        self.chunk_dispatches += 1
-        metrics.STREAM_BATCH.labels(eng.bundle.name).observe(len(self.active))
-        self._inflight_chunks.append((toks, done, dict(self.active)))
+                entry = ((toks, done), dict(self.active), 1)
+        self._note_dispatched(entry)
+
+    def _route_entry(self, fetched, snapshot, w: int) -> None:
+        """Route one fetched in-flight entry: a (toks, done) pair from
+        the per-chunk path, or a (toks, done_hist, n_chunks) window."""
+        if len(fetched) == 3:
+            toks_np, hist_np, nc = fetched
+            self._route_window(toks_np, hist_np, int(nc), snapshot, w)
+        else:
+            toks_np, done_np = fetched
+            self._route_chunk(toks_np, done_np, snapshot)
 
     def _deliver_oldest(self) -> None:
         import jax
 
         if not self._inflight_chunks:
             return
-        toks, done, snapshot = self._inflight_chunks.pop(0)
-        toks_np, done_np = self.engine.dispatch_guard(
-            "fetch", lambda: jax.device_get((toks, done))
+        fetchables, snapshot, w = self._inflight_chunks.pop(0)
+        fetched = self.engine.dispatch_guard(
+            "fetch", lambda: jax.device_get(fetchables)
         )
-        self._route_chunk(toks_np, done_np, snapshot)
+        self._route_entry(fetched, snapshot, w)
 
     def _deliver_all(self) -> None:
-        """Drain every in-flight chunk with ONE combined device_get."""
+        """Drain every in-flight dispatch with ONE combined device_get."""
         import jax
 
         if not self._inflight_chunks:
@@ -2558,10 +2711,77 @@ class ContinuousDecodeLoop:
         self._inflight_chunks = []
         fetched = self.engine.dispatch_guard(
             "fetch",
-            lambda: jax.device_get([(t, d) for t, d, _ in entries]),
+            lambda: jax.device_get([f for f, _, _ in entries]),
         )
-        for (_, _, snapshot), (toks_np, done_np) in zip(entries, fetched):
-            self._route_chunk(toks_np, done_np, snapshot)
+        for (_, snapshot, w), got in zip(entries, fetched):
+            self._route_entry(got, snapshot, w)
+
+    def _deliver_ready(self) -> None:
+        """Opportunistic delivery of in-flight work whose buffers are
+        ALREADY on this side of the wire (``is_ready`` — the async
+        host copies started at dispatch): paged mode frees EOS'd rows'
+        blocks at fetch/reconcile time, BEFORE the next dispatch's
+        growth pass would keep granting blocks to rows the device
+        already finished.  Costs nothing when data is still in flight
+        (no sync — the depth-D cadence is untouched)."""
+        if not self.paged:
+            return
+        import jax
+
+        while self._inflight_chunks:
+            fetchables = self._inflight_chunks[0][0]
+            try:
+                if not all(
+                    leaf.is_ready() for leaf in jax.tree.leaves(fetchables)
+                ):
+                    return
+            except AttributeError:  # backend without is_ready probes
+                return
+            self._deliver_oldest()
+
+    def _route_window(self, toks_np, hist_np, nc: int, snapshot,
+                      w: int) -> None:
+        """Window delivery = the per-chunk routing replayed over the
+        ``nc`` chunks the device actually ran: same chunk segments,
+        same per-boundary done flags (``done_hist``), same budget
+        cursor — token-identical to fetching each chunk separately,
+        at one host sync for the lot."""
+        chunk = self.engine.chunk_tokens
+        self.window_chunks += nc
+        if nc < w:
+            self.window_early_exits += 1
+            metrics.WINDOW_EARLY_EXITS.labels(self.engine.bundle.name).inc()
+            if self._flight is not None:
+                self._flight.event("window_early_exit", ran=nc, window=w)
+        for c in range(nc):
+            self._route_chunk(
+                toks_np[:, c * chunk : (c + 1) * chunk], hist_np[c], snapshot
+            )
+        if nc < w and self.paged:
+            self._reconcile_window(snapshot, w - nc)
+
+    def _reconcile_window(self, snapshot, unran_chunks: int) -> None:
+        """Window-boundary ledger reconcile: chunks an early-exited
+        window never ran were still pre-provisioned at dispatch — walk
+        the snapshot's still-live tenants, roll their dispatched-step
+        cursor back and return the over-granted tail blocks to the
+        pool.  (Rows that EOS'd or finished their budget were already
+        fully freed by the routing above.)"""
+        chunk = self.engine.chunk_tokens
+        trimmed = False
+        for slot, st in snapshot.items():
+            if self.active.get(slot) is not st or st.blocks is None:
+                continue
+            steps = max(
+                0, self._dispatched_steps.get(slot, 0) - unran_chunks * chunk
+            )
+            self._dispatched_steps[slot] = steps
+            need = min(st.s_base + steps, st.s_base + st.budget)
+            trimmed |= bool(st.blocks.trim(need))
+            n = len(st.blocks.ids)
+            self._table[slot, n:] = self.pool.num_blocks
+        if trimmed and self.admission is not None:
+            self.admission.note_pool()
 
     def _route_chunk(self, toks_np, done_np, snapshot) -> None:
         eng = self.engine
@@ -2669,6 +2889,7 @@ class ContinuousDecodeLoop:
                         eng.params, self._state, eng.chunk_tokens, flag
                     )
                     jax.device_get(toks)
+        self._warm_windows(warm_sampled)
         # Re-warm the inserts in SERVING order — against a chunk-OUTPUT
         # batched state.  The first such call in a process pays a
         # ~1-8 s one-time cost through the relay (measured; absent when
@@ -2839,11 +3060,43 @@ class ContinuousDecodeLoop:
                     eng.chunk_tokens, flag,
                 )
                 jax.device_get(toks)
+        self._warm_windows(warm_sampled)
         if self.prefill_chunk:
             self._warm_prefill()
         if self._auto_depth:
             self._tune_chain_depth_paged()
         self._build_empty_state()
+
+    def _warm_windows(self, warm_sampled: bool) -> None:
+        """Compile the fused-window executables off the request path:
+        one per (power-of-two W ≤ cap, sample flag) — exactly the grid
+        the governor can pick from (it floors to a power of two for
+        this reason).  The all-dead warm state exits every window at
+        chunk 0, so each warm call costs one compile + one dispatch."""
+        if self.decode_window <= 1 or self.spec:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        eng = self.engine
+        flags = (False, True) if warm_sampled else (False,)
+        w = 2
+        while w <= self.decode_window:
+            for flag in flags:
+                with eng._lock:
+                    if self.paged:
+                        self._state, toks, _, _ = self._window_fn()(
+                            eng.params, self._state,
+                            jnp.asarray(self._table), eng.chunk_tokens, w,
+                            flag,
+                        )
+                    else:
+                        self._state, toks, _, _ = self._window_fn()(
+                            eng.params, self._state, eng.chunk_tokens, w,
+                            flag,
+                        )
+                    jax.device_get(toks)
+            w *= 2
 
     def _tune_chain_depth_paged(self) -> None:
         """Paged variant of ``_tune_chain_depth`` (the chunk takes the
@@ -2873,8 +3126,27 @@ class ContinuousDecodeLoop:
         w5 = wall(5)
         compute = max((w5 - w1) / 4.0, 1e-4)
         rtt = max(w1 - compute, 0.0)
-        self.chain_depth = max(1, min(8, round(rtt / compute)))
+        self._apply_tuned_depth(rtt, compute)
+
+    @staticmethod
+    def depth_from(rtt_s: float, compute_s: float) -> int:
+        """Chain depth from measured numbers: cadence ≈ max(RTT/D,
+        chunk compute), so D ≈ RTT/compute closes the gap to the wire;
+        clamped to [1, 8] (deeper chains only add fetch latency)."""
+        return max(1, min(8, round(rtt_s / max(compute_s, 1e-4))))
+
+    def _apply_tuned_depth(self, rtt: float, compute: float) -> None:
+        self.chain_depth = self.depth_from(rtt, compute)
+        metrics.CHAIN_DEPTH.labels(self.engine.bundle.name).set(
+            self.chain_depth
+        )
         self._admit_grace_s = min(self._admit_grace_s, rtt / 10.0)
+        log.info(
+            "continuous loop: chunk compute %.1f ms, dispatch RTT %.1f ms "
+            "-> chain depth %d, admit grace %.1f ms",
+            compute * 1e3, rtt * 1e3, self.chain_depth,
+            self._admit_grace_s * 1e3,
+        )
 
     def _tune_chain_depth(self) -> None:
         """Pick the chunk-chain pipelining depth from measured numbers:
@@ -2912,15 +3184,8 @@ class ContinuousDecodeLoop:
         w5 = wall(5)
         compute = max((w5 - w1) / 4.0, 1e-4)
         rtt = max(w1 - compute, 0.0)
-        self.chain_depth = max(1, min(8, round(rtt / compute)))
         # The cold-burst grace is only worth paying when a wasted
         # admission round-trip dwarfs it: scale it to the measured RTT
         # so directly-attached chips (~1 ms dispatch) don't tax every
         # isolated request ~8 ms of TTFT for a burst that never comes.
-        self._admit_grace_s = min(self._admit_grace_s, rtt / 10.0)
-        log.info(
-            "continuous loop: chunk compute %.1f ms, dispatch RTT %.1f ms "
-            "-> chain depth %d, admit grace %.1f ms",
-            compute * 1e3, rtt * 1e3, self.chain_depth,
-            self._admit_grace_s * 1e3,
-        )
+        self._apply_tuned_depth(rtt, compute)

@@ -232,6 +232,22 @@ class ServiceConfig(BaseModel):
     # the continuous loop's slot width (contiguous mode) / block-table
     # width (paged), so cap it when HBM is tight.
     prefill_max_prompt: int = 0
+    # Fused decode windows (docs/decode-fusion.md): cap on how many
+    # decode chunks fuse into ONE device dispatch (a lax.while_loop
+    # over whole chunk scans with on-device EOS early exit), so the
+    # host submits/fetches once per window instead of per chunk — the
+    # knob that attacks the host-round-trip ceiling the round-11
+    # attribution measured (host_share ≈ 1.0 at the chunk/fetch
+    # sites).  1 = off (the seed's one-chunk dispatches, exactly).
+    # Requires a window-capable family (gpt2/llama); rejected with
+    # SPEC_CONTINUOUS (spec rounds have their own fused shape).
+    decode_window: int = 1
+    # Auto window policy: drop to W=1 whenever interactive streams are
+    # live or waiting (their TBT/admission cadence binds at chunk
+    # granularity), fuse up to DECODE_WINDOW for batch-class and idle
+    # backfill.  0 = always fuse to the cap (throughput lanes with no
+    # interactive SLA).
+    decode_window_auto: bool = True
     # Interactive arrivals may preempt batch-class streams (checkpoint
     # the cursor, free the slot, re-queue for token-identical resume)
     # when every slot is busy.  Only reachable with MAX_STREAM_QUEUE>0.
@@ -384,6 +400,13 @@ class ServiceConfig(BaseModel):
             )
         return v
 
+    @field_validator("decode_window")
+    @classmethod
+    def _check_decode_window(cls, v: int) -> int:
+        if not (1 <= v <= 64):
+            raise ValueError("DECODE_WINDOW must be in [1, 64]")
+        return v
+
     @field_validator("fault_spec")
     @classmethod
     def _check_fault_spec(cls, v: str | None) -> str | None:
@@ -441,7 +464,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
       DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, PREFILL_CHUNK,
-      PREFILL_BUDGET, PREFILL_MAX_PROMPT, FAULT_SPEC, FAULT_SEED,
+      PREFILL_BUDGET, PREFILL_MAX_PROMPT, DECODE_WINDOW,
+      DECODE_WINDOW_AUTO, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
       ENGINE_RESTARTS_MAX, SUPERVISE, TRACE, TRACE_RING, FLIGHT_RING,
       PROFILE_DIR, LOG_FORMAT.
@@ -496,6 +520,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "prefill_chunk": "PREFILL_CHUNK",
         "prefill_budget": "PREFILL_BUDGET",
         "prefill_max_prompt": "PREFILL_MAX_PROMPT",
+        "decode_window": "DECODE_WINDOW",
         "fault_seed": "FAULT_SEED",
         "dispatch_retries": "DISPATCH_RETRIES",
         "engine_restarts_max": "ENGINE_RESTARTS_MAX",
@@ -525,6 +550,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PREEMPT")
     if v is not None:
         kwargs["preempt"] = v.lower() not in ("0", "false", "no")
+    v = get("DECODE_WINDOW_AUTO")
+    if v is not None:
+        kwargs["decode_window_auto"] = v.lower() not in ("0", "false", "no")
     v = get("PAGED_KV")
     if v is not None:
         kwargs["paged_kv"] = v.lower() not in ("0", "false", "no")

@@ -170,6 +170,26 @@ STREAMS_LOST = Counter(
     "(no supervisor, or the restart budget was exhausted)",
     ["model"],
 )
+CHAIN_DEPTH = Gauge(
+    "stream_chain_depth",
+    "Chunk-chain pipelining depth the continuous decode loop runs at "
+    "(STREAM_PIPELINE; auto-tuned at warmup from measured dispatch RTT "
+    "vs chunk compute when 0)",
+    ["model"],
+)
+DECODE_WINDOW_CHUNKS = Histogram(
+    "decode_window_chunks",
+    "Decode chunks fused per window dispatch (DECODE_WINDOW; 1 = the "
+    "unfused per-chunk path) — host syncs per token scale inversely "
+    "with this",
+    ["model"], buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+WINDOW_EARLY_EXITS = Counter(
+    "decode_window_early_exits_total",
+    "Fused decode windows that exited on-device before their chunk cap "
+    "because every live row hit EOS",
+    ["model"],
+)
 KV_GROWTH_STALLS = Counter(
     "kv_growth_stalls_total",
     "Paged-KV decode growth found the pool dry: the stream was "

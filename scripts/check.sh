@@ -67,6 +67,23 @@ else
     echo "== chunked-prefill smoke skipped (PREFILL_SMOKE=0) =="
 fi
 
+# Fused-decode smoke: 3-point DECODE_WINDOW matrix, each run under a
+# chunk-site transient FAULT_SPEC through the watchdog retry path,
+# expecting token-identical completion and a drained block pool
+# (chaos tier, so it stays out of tier-1).  FUSE_SMOKE=0 skips.
+if [ "${FUSE_SMOKE:-1}" != "0" ]; then
+    echo "== fused-decode smoke matrix =="
+    for w in 1 2 4; do
+        echo "-- FUSE_SMOKE_WINDOW=$w (chunk:transient@2)"
+        timeout -k 10 240 env JAX_PLATFORMS=cpu FUSE_SMOKE_WINDOW="$w" \
+            FUSE_SMOKE_SPEC="chunk:transient@2" \
+            python -m pytest tests/test_decode_window.py::test_decode_window_smoke \
+            -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+    done
+else
+    echo "== fused-decode smoke skipped (FUSE_SMOKE=0) =="
+fi
+
 # Observability smoke: the full HTTP service under TRACE=1 with a
 # transient fault injected, then /debug/trace (schema-valid Perfetto
 # JSON with every stage span) and /debug/engine (flight recorder with

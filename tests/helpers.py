@@ -183,6 +183,14 @@ def tiny_gpt_bundle(seed: int = 0) -> ModelBundle:
                 p, cfg, st, tr, i, m, start
             )
         ),
+        window_fn=lambda p, s, n, w, sample=False: gpt_mod.generate_window(
+            p, cfg, s, n, w, sample
+        ),
+        paged_window_fn=(
+            lambda p, s, t, n, w, sample=False: gpt_mod.generate_window_paged(
+                p, cfg, s, t, n, w, sample
+            )
+        ),
         supports_prefix=True,
     )
 
@@ -218,6 +226,14 @@ def tiny_llama_bundle(seed: int = 0, kv_quant: bool = False) -> ModelBundle:
         paged_prefill_chunk_fn=(
             lambda p, st, tr, i, m, start: llama_mod.paged_prefill_chunk(
                 p, cfg, st, tr, i, m, start
+            )
+        ),
+        window_fn=lambda p, s, n, w, sample=False: llama_mod.generate_window(
+            p, cfg, s, n, w, sample
+        ),
+        paged_window_fn=(
+            lambda p, s, t, n, w, sample=False: llama_mod.generate_window_paged(
+                p, cfg, s, t, n, w, sample
             )
         ),
         supports_prefix=True,

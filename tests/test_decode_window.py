@@ -1,0 +1,626 @@
+"""Fused decode windows (DECODE_WINDOW; models/window.py +
+engine/streams.py + scheduler/policy.DecodeWindowGovernor).
+
+The judged contracts:
+1. A W-chunk fused window is TOKEN-IDENTICAL to W per-chunk dispatches
+   — model level (gpt/llama × {fp, int8} × {contiguous, paged}) and
+   loop level (greedy AND pinned-seed sampled), non-divisor budgets
+   included; the per-chunk ``done_hist`` matches what per-chunk
+   fetches would have seen.
+2. On-device EOS early exit: the while_loop stops at the first chunk
+   boundary where every row is done and reports the true chunk count.
+3. Paged ledger exactness at window granularity: blocks pre-provision
+   for the whole window, EOS'd rows' blocks return at fetch/reconcile
+   time (while other streams still decode — the pool-occupancy pin),
+   the pool drains to zero after every schedule, and ``trim`` never
+   leaks or double-frees (BlockPool raises on double free).
+4. A fatal device fault mid-window checkpoints at the delivered-token
+   cursor and resumes token-identically (supervised rebuild).
+5. The governor: W=1 whenever interactive work is live or waiting,
+   power-of-two fused depth for batch-only traffic, clamped to
+   remaining work; DECODE_WINDOW=1 leaves the seed path untouched;
+   invalid combinations reject at build.
+6. The auto-tuned chain depth is pinned (``depth_from``) and surfaced
+   (stream_chain_depth gauge + /status.decode).
+"""
+
+import asyncio
+import time
+from typing import NamedTuple
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.kv_blocks import (
+    BlockPool,
+    StreamBlocks,
+    blocks_for,
+)
+from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+from mlmicroservicetemplate_tpu.engine.supervisor import Supervisor
+from mlmicroservicetemplate_tpu.models.window import decode_window
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.scheduler.policy import DecodeWindowGovernor
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import TINY_GPT, TINY_LLAMA, tiny_gpt_bundle, tiny_llama_bundle
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2, 4))
+    kw.setdefault("seq_buckets", (16, 32))
+    kw.setdefault("max_decode_len", 24)
+    kw.setdefault("stream_chunk_tokens", 4)
+    kw.setdefault("max_streams", 4)
+    return ServiceConfig(**kw)
+
+
+async def _consume(gen):
+    out = []
+    async for c in gen:
+        out.extend(np.asarray(c).tolist())
+    return out
+
+
+def _run(cdl, feats_list):
+    async def body():
+        return await asyncio.gather(
+            *[_consume(cdl.submit_stream(dict(f))) for f in feats_list]
+        )
+
+    return asyncio.run(body())
+
+
+def _solo_tokens(engine, feats):
+    return np.concatenate(list(engine.generate_stream(dict(feats)))).tolist()
+
+
+def _prompt(rng, n):
+    return rng.integers(5, 250, n).astype(np.int32)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# 1. driver semantics (stub chunk_fn: exact control over done/early exit)
+
+
+class _StubState(NamedTuple):
+    counter: jnp.ndarray  # [] chunks executed so far
+    done: jnp.ndarray  # [B]
+
+
+def _stub_chunk(n_steps: int, done_at: jnp.ndarray):
+    """chunk_fn whose row b goes done after ``done_at[b]`` chunks and
+    whose tokens encode (chunk index, step index) — routing/ordering
+    errors are visible in the values themselves."""
+
+    def fn(s):
+        i = s.counter
+        b = s.done.shape[0]
+        toks = (
+            (i + 1) * 100
+            + jnp.arange(n_steps)[None, :]
+            + 10_000 * jnp.arange(b)[:, None]
+        ).astype(jnp.int32)
+        return _StubState(i + 1, (i + 1) >= done_at), toks
+
+    return fn
+
+
+def test_driver_early_exit_and_history():
+    done_at = jnp.asarray([2, 3])  # row 0 done after chunk 2, row 1 after 3
+    st = _StubState(jnp.int32(0), jnp.zeros(2, bool))
+    st, buf, hist, n = decode_window(_stub_chunk(4, done_at), st, 4, 8, -1)
+    assert int(n) == 3  # exits at the first all-done boundary, not the cap
+    buf, hist = np.asarray(buf), np.asarray(hist)
+    # Executed chunks carry their values; unexecuted stay at pad.
+    for c in range(3):
+        np.testing.assert_array_equal(
+            buf[0, c * 4 : (c + 1) * 4], (c + 1) * 100 + np.arange(4)
+        )
+    assert (buf[:, 12:] == -1).all()
+    # done_hist per boundary matches the schedule; unexecuted read done.
+    np.testing.assert_array_equal(
+        hist[:4], [[False, False], [True, False], [True, True], [True, True]]
+    )
+
+
+def test_driver_zero_chunks_when_all_done():
+    st = _StubState(jnp.int32(0), jnp.ones(2, bool))
+    _, buf, _, n = decode_window(
+        _stub_chunk(4, jnp.asarray([1, 1])), st, 4, 8, -1
+    )
+    assert int(n) == 0 and (np.asarray(buf) == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# 2. model-level window identity (real families, contiguous + paged)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama", "llama-int8"])
+def test_model_window_identity(family):
+    if family == "gpt":
+        from mlmicroservicetemplate_tpu.models import gpt as mod
+
+        cfg = mod.GPTConfig(**TINY_GPT)
+    else:
+        from mlmicroservicetemplate_tpu.models import llama as mod
+
+        cfg = mod.LlamaConfig(**TINY_LLAMA, kv_quant=family == "llama-int8")
+    params = mod.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    ids = _prompt(rng, 11)[None]
+    mask = np.ones_like(ids)
+    chunk, W = 4, 4
+    st = mod.init_decode_state(
+        params, cfg, jnp.asarray(ids), jnp.asarray(mask), chunk * W
+    )
+    ref, ref_chunks, ref_done = st, [], []
+    for _ in range(W):
+        ref, t = mod.generate_chunk(params, cfg, ref, chunk)
+        ref_chunks.append(np.asarray(t))
+        ref_done.append(np.asarray(ref.done))
+    wst, toks, hist, n = mod.generate_window(params, cfg, st, chunk, W)
+    n = int(n)
+    np.testing.assert_array_equal(
+        np.asarray(toks)[:, : n * chunk],
+        np.concatenate(ref_chunks, axis=1)[:, : n * chunk],
+    )
+    np.testing.assert_array_equal(np.asarray(hist)[:n], ref_done[:n])
+    # Post-window state continues identically to the per-chunk state.
+    a, _ = mod.generate_chunk(params, cfg, wst, chunk)
+    b, _ = mod.generate_chunk(params, cfg, ref, chunk)
+    np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
+
+
+# ---------------------------------------------------------------------------
+# 3. loop-level identity across the lever matrix
+
+
+@pytest.mark.parametrize(
+    "family,paged,quant",
+    [
+        ("gpt", False, False),
+        ("gpt", True, False),
+        ("llama", False, True),
+        ("llama", True, True),
+    ],
+)
+def test_loop_window_identity(family, paged, quant):
+    """DECODE_WINDOW=4 (forced deep: auto off) serves the exact tokens
+    the per-chunk engine does, and actually fuses (window_dispatches
+    > 0 with multi-chunk windows); the paged pool drains to zero."""
+    bundle = (
+        tiny_gpt_bundle() if family == "gpt"
+        else tiny_llama_bundle(kv_quant=quant)
+    )
+    kw = dict(decode_window=4, decode_window_auto=False)
+    if quant:
+        kw["quant_kv"] = "int8"
+    if paged:
+        kw.update(paged_kv=True, kv_block_size=8)
+    cfgw = _cfg(**kw)
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(
+        bundle, _cfg(**({"quant_kv": "int8"} if quant else {})),
+        ReplicaSet(make_mesh(1)),
+    )
+    rng = np.random.default_rng(0)
+    feats = [
+        {"input_ids": p, "length": np.int32(len(p))}
+        for p in (_prompt(rng, n) for n in (7, 13, 20))
+    ]
+    solos = [_solo_tokens(eng0, f) for f in feats]
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    try:
+        outs = _run(cdl, feats)
+        assert outs == solos
+        assert cdl.window_dispatches > 0 and cdl.window_chunks > 0
+        if paged:
+            assert _wait(lambda: engw.kv_pool.used_blocks == 0)
+    finally:
+        cdl.stop()
+
+
+def test_loop_window_sampled_pinned_seed():
+    """A pinned-seed sampled stream under deep windows draws the exact
+    sequence the per-chunk B=1 path draws — the RNG chain advances
+    inside the fused dispatch exactly as it would across chunks."""
+    bundle = tiny_gpt_bundle()
+    cfgw = _cfg(decode_window=4, decode_window_auto=False)
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(1)
+    f = {
+        "input_ids": _prompt(rng, 9), "length": np.int32(9),
+        "temperature": 0.9, "top_k": 20, "seed": 4321,
+    }
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    try:
+        assert _run(cdl, [f])[0] == _solo_tokens(eng0, f)
+        assert cdl.window_dispatches > 0
+    finally:
+        cdl.stop()
+
+
+def test_loop_window_non_divisor_budget():
+    """A max_tokens budget that is NOT a multiple of W·chunk (10 vs
+    window capacity 16) delivers exactly the per-chunk tokens — the
+    budget cursor still advances at chunk granularity inside the
+    routed window."""
+    bundle = tiny_gpt_bundle()
+    cfgw = _cfg(decode_window=4, decode_window_auto=False)
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(), ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(2)
+    f = {
+        "input_ids": _prompt(rng, 12), "length": np.int32(12),
+        "max_tokens": 10,
+    }
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    try:
+        assert _run(cdl, [f])[0] == _solo_tokens(eng0, f)
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. paged ledger at window granularity
+
+
+def _eos_rigged_bundle(eos_id: int):
+    """tiny_gpt with cfg.eos_id re-pinned to a token the deterministic
+    greedy generation actually emits — a controllable on-device EOS."""
+    from mlmicroservicetemplate_tpu.models import gpt as gpt_mod
+    from mlmicroservicetemplate_tpu.models.registry import (
+        KIND_SEQ2SEQ,
+        ModelBundle,
+    )
+    from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
+    from mlmicroservicetemplate_tpu.runtime.device import default_policy
+
+    cfg = gpt_mod.GPTConfig(**{**TINY_GPT, "eos_id": eos_id})
+    params = gpt_mod.init_params(jax.random.PRNGKey(0), cfg)
+    return ModelBundle(
+        name="gpt2", kind=KIND_SEQ2SEQ, cfg=cfg, params=params,
+        policy=default_policy("cpu"), tokenizer=ByteTokenizer(add_eos=True),
+        labels=None, forward=None,
+        encode_fn=lambda p, i, m: i,
+        init_state_fn=lambda p, i, m, ml, sample=None: (
+            gpt_mod.init_decode_state(p, cfg, i, m, ml, sample=sample)
+        ),
+        generate_chunk_fn=lambda p, s, n, sample=False: (
+            gpt_mod.generate_chunk(p, cfg, s, n, sample)
+        ),
+        paged_chunk_fn=lambda p, s, t, n, sample=False: (
+            gpt_mod.generate_chunk_paged(p, cfg, s, t, n, sample)
+        ),
+        window_fn=lambda p, s, n, w, sample=False: gpt_mod.generate_window(
+            p, cfg, s, n, w, sample
+        ),
+        paged_window_fn=(
+            lambda p, s, t, n, w, sample=False: gpt_mod.generate_window_paged(
+                p, cfg, s, t, n, w, sample
+            )
+        ),
+        supports_prefix=True,
+    )
+
+
+def test_eos_row_blocks_freed_while_others_decode():
+    """Pool-occupancy pin (the fetch/reconcile free): when one stream
+    EOSes on-device early, its blocks return to the pool at the
+    boundary where its done flag is fetched — NOT when the other,
+    still-live stream eventually finishes."""
+    rng = np.random.default_rng(3)
+    pa, pb = _prompt(rng, 7), _prompt(rng, 9)
+    # Find a token stream A emits early, to rig as EOS.
+    probe = tiny_gpt_bundle()
+    eng_probe = InferenceEngine(probe, _cfg(), ReplicaSet(make_mesh(1)))
+    fa = {"input_ids": pa, "length": np.int32(7)}
+    fb = {"input_ids": pb, "length": np.int32(9), "max_tokens": 48}
+    a_solo = _solo_tokens(eng_probe, fa)
+    eos = a_solo[0]  # A emits this immediately -> device-done at step 0
+    b_solo = _solo_tokens(eng_probe, fb)
+    assume_clean = eos not in b_solo[:24]
+    if not assume_clean:
+        pytest.skip("rigged eos collides with stream B's early tokens")
+    bundle = _eos_rigged_bundle(int(eos))
+    cfgw = _cfg(
+        decode_window=4, decode_window_auto=False, paged_kv=True,
+        kv_block_size=8, max_decode_len=48,
+    )
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    pool = engw.kv_pool
+    try:
+        async def body():
+            gen_a = cdl.submit_stream(dict(fa))
+            gen_b = cdl.submit_stream(dict(fb))
+            task_b = asyncio.ensure_future(_consume(gen_b))
+            out_a = await _consume(gen_a)
+            # A is done (eos fetched).  B still holds its blocks and
+            # keeps decoding; A's blocks must return promptly — before
+            # B finishes — leaving only B's footprint.
+            b_max = blocks_for(9 + 48, 8)
+            for _ in range(200):
+                if pool.used_blocks <= b_max and not task_b.done():
+                    break
+                await asyncio.sleep(0.01)
+            held = pool.used_blocks
+            b_running = not task_b.done()
+            out_b = await task_b
+            return out_a, out_b, held, b_running
+
+        out_a, out_b, held, b_running = asyncio.run(body())
+        # Device EOS at step 0; the first chunk pads out past it.
+        assert out_a[0] == eos and len(out_a) <= 4
+        assert b_running and held <= blocks_for(9 + 48, 8)
+        assert _wait(lambda: pool.used_blocks == 0)
+    finally:
+        cdl.stop()
+
+
+def test_window_ledger_property_early_exits():
+    """Property: mixed budgets, early device EOS and deep windows leave
+    the pool drained with zero leaked or double-granted blocks (the
+    BlockPool raises on double free; drain-to-zero catches leaks)."""
+    rng = np.random.default_rng(4)
+    probe = tiny_gpt_bundle()
+    eng_probe = InferenceEngine(probe, _cfg(), ReplicaSet(make_mesh(1)))
+    f0 = {"input_ids": _prompt(rng, 7), "length": np.int32(7)}
+    eos = _solo_tokens(eng_probe, f0)[1]
+    bundle = _eos_rigged_bundle(int(eos))
+    cfgw = _cfg(
+        decode_window=4, decode_window_auto=False, paged_kv=True,
+        kv_block_size=8, max_decode_len=32,
+    )
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    try:
+        for round_i in range(3):
+            feats = [
+                {
+                    "input_ids": _prompt(rng, int(rng.integers(5, 28))),
+                    "length": np.int32(0),  # fixed below
+                    "max_tokens": int(rng.integers(3, 32)),
+                }
+                for _ in range(4)
+            ]
+            for f in feats:
+                f["length"] = np.int32(len(f["input_ids"]))
+            outs = _run(cdl, feats)
+            assert all(len(o) > 0 for o in outs)
+            assert _wait(lambda: engw.kv_pool.used_blocks == 0), (
+                round_i, engw.kv_pool.stats()
+            )
+    finally:
+        cdl.stop()
+
+
+def test_stream_blocks_trim():
+    pool = BlockPool(16)
+    sb = StreamBlocks(pool, 8)
+    sb.ensure(100)  # 13 blocks
+    assert pool.used_blocks == 13
+    freed = sb.trim(40)  # keep 5
+    assert len(freed) == 8 and pool.used_blocks == 5
+    assert sb.trim(40) == []  # idempotent
+    # Never trims into an adopted CoW prefix.
+    donor = StreamBlocks(pool, 8)
+    donor.ensure(16)  # 2 blocks
+    sharer = StreamBlocks(pool, 8)
+    sharer.adopt(list(donor.ids))
+    sharer.ensure(40)  # +3 own
+    assert sharer.trim(0) and len(sharer.ids) == sharer.shared == 2
+    sharer.release()
+    donor.release()
+    sb.release()
+    assert pool.used_blocks == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. fault tolerance: fatal mid-window -> checkpoint-resume identity
+
+
+def test_mid_window_fatal_checkpoint_resume():
+    """A fatal device fault on a fused-window dispatch checkpoints
+    every stream at its delivered-token cursor and resumes
+    token-identically across the supervised rebuild."""
+    bundle = tiny_gpt_bundle()
+    cfgw = _cfg(
+        decode_window=4, decode_window_auto=False,
+        fault_spec="chunk:fatal@2", max_decode_len=32,
+    )
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(max_decode_len=32),
+                           ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(5)
+    feats = [
+        {"input_ids": p, "length": np.int32(len(p))}
+        for p in (_prompt(rng, 7), _prompt(rng, 13))
+    ]
+    solos = [_solo_tokens(eng0, f) for f in feats]
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    cdl.supervisor = Supervisor(cfgw)
+    try:
+        outs = _run(cdl, feats)
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            assert got[:n] == want[:n]
+        assert cdl.supervisor.restarts >= 1
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# 6. governor + build gates + chain-depth surfacing
+
+
+def test_governor_policy():
+    gov = DecodeWindowGovernor(8, auto=True)
+    # Interactive live or waiting -> 1; batch-only -> deep.
+    assert gov.pick(8, True, False) == 1
+    assert gov.pick(8, False, True) == 1
+    assert gov.pick(8, False, False) == 8
+    # Clamped to remaining work, power-of-two floored.
+    assert gov.pick(3, False, False) == 2
+    assert gov.pick(1, False, False) == 1
+    assert gov.pick(0, False, False) == 1
+    # Non-power-of-two cap floors.
+    assert DecodeWindowGovernor(6, auto=True).pick(8, False, False) == 4
+    # auto=0 fuses regardless of interactive traffic.
+    gov0 = DecodeWindowGovernor(4, auto=False)
+    assert gov0.pick(8, True, True) == 4
+    # Cap 1 = off.
+    assert DecodeWindowGovernor(1).pick(8, False, False) == 1
+
+
+def test_loop_auto_governor_interactive_stays_per_chunk():
+    """Default (interactive) streams under DECODE_WINDOW with the auto
+    policy never see a fused window — TBT cadence is untouched."""
+    bundle = tiny_gpt_bundle()
+    cfgw = _cfg(decode_window=4)  # auto on by default
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(6)
+    f = {"input_ids": _prompt(rng, 9), "length": np.int32(9)}
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    try:
+        _run(cdl, [f])
+        assert cdl.window_dispatches == 0 and cdl.chunk_dispatches > 0
+    finally:
+        cdl.stop()
+
+
+def test_window_rejects_incapable_family_and_spec():
+    from helpers import tiny_t5_bundle
+
+    bundle = tiny_t5_bundle()
+    cfgw = _cfg(decode_window=4)
+    eng = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    with pytest.raises(ValueError, match="DECODE_WINDOW"):
+        ContinuousDecodeLoop(eng, cfgw)
+    with pytest.raises(ValueError):
+        ServiceConfig(device="cpu", decode_window=0)
+    with pytest.raises(ValueError):
+        ServiceConfig(device="cpu", decode_window=65)
+
+
+def test_depth_from_pins():
+    """The auto chain-depth formula (STREAM_PIPELINE=0): D ≈
+    RTT/compute, clamped to [1, 8] — pinned so the tuner can't drift
+    silently (it used to be invisible and untested)."""
+    d = ContinuousDecodeLoop.depth_from
+    assert d(0.0, 0.005) == 1  # direct-attached: no pipelining
+    assert d(0.010, 0.005) == 2
+    assert d(0.100, 0.012) == 8  # relay regime
+    assert d(1.0, 0.001) == 8  # clamp
+    assert d(0.0, 0.0) == 1  # zero-compute guard (no div-by-zero)
+    assert d(0.001, 0.0) == 8  # zero compute floors at 1e-4 -> relay-like
+
+
+def test_status_surfaces_chain_depth_and_window_stats():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mlmicroservicetemplate_tpu.api import build_app
+    from mlmicroservicetemplate_tpu.scheduler import Batcher
+
+    async def main():
+        cfg = _cfg(decode_window=4, stream_pipeline=2)
+        bundle = tiny_gpt_bundle()
+        engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(engine, cfg)
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(200):
+                resp = await client.get("/readyz")
+                if resp.status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            resp = await client.get("/status")
+            body = await resp.json()
+            dec = body["decode"]
+            assert dec["chain_depth"] == 2 and dec["chain_depth_auto"] is False
+            assert dec["window_cap"] == 4
+            assert {"window_dispatches", "window_chunks",
+                    "window_early_exits", "tokens_emitted"} <= set(dec)
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(main())
+
+
+def test_chain_depth_gauge_set_on_tune():
+    """_apply_tuned_depth publishes stream_chain_depth (the satellite:
+    the chosen depth used to be invisible)."""
+    from mlmicroservicetemplate_tpu.utils import metrics
+
+    bundle = tiny_gpt_bundle()
+    cfg = _cfg(stream_pipeline=0)
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        cdl._apply_tuned_depth(rtt=0.02, compute=0.005)
+        assert cdl.chain_depth == 4
+        if metrics.HAVE_PROM:
+            g = metrics.CHAIN_DEPTH.labels("gpt2")
+            assert g._value.get() == 4
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# 7. chaos-tier smoke for scripts/check.sh (FUSE_SMOKE)
+
+
+@pytest.mark.chaos
+def test_decode_window_smoke():
+    """3-point DECODE_WINDOW matrix entry: scripts/check.sh runs this
+    with FUSE_SMOKE_WINDOW ∈ {1, 2, 4} under a chunk-site transient
+    fault, expecting token-identical completion through the watchdog
+    retry (the guarded callable is functional, so a retried WINDOW is
+    token-identical by construction too)."""
+    import os
+
+    w = int(os.environ.get("FUSE_SMOKE_WINDOW", "4"))
+    spec = os.environ.get("FUSE_SMOKE_SPEC", "chunk:transient@2")
+    bundle = tiny_gpt_bundle()
+    cfgw = _cfg(
+        decode_window=w, decode_window_auto=False, fault_spec=spec,
+        dispatch_retries=2, dispatch_backoff_s=0.01, max_decode_len=32,
+        paged_kv=True, kv_block_size=8,
+    )
+    engw = InferenceEngine(bundle, cfgw, ReplicaSet(make_mesh(1)))
+    eng0 = InferenceEngine(bundle, _cfg(max_decode_len=32),
+                           ReplicaSet(make_mesh(1)))
+    rng = np.random.default_rng(7)
+    feats = [
+        {"input_ids": p, "length": np.int32(len(p))}
+        for p in (_prompt(rng, 7), _prompt(rng, 13))
+    ]
+    solos = [_solo_tokens(eng0, f) for f in feats]
+    cdl = ContinuousDecodeLoop(engw, cfgw)
+    cdl.supervisor = Supervisor(cfgw)
+    try:
+        outs = _run(cdl, feats)
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            assert got[:n] == want[:n]
+        assert _wait(lambda: engw.kv_pool.used_blocks == 0)
+    finally:
+        cdl.stop()
