@@ -1,0 +1,174 @@
+"""Replica-failover A/B: goodput + p99 TTFT through a replica kill and
+recovery, fleet vs single replica.
+
+The judged claim (ISSUE 8): with the SAME deterministic replica-kill
+schedule (``r0:chunk:fatal@3`` and a spent restart budget — replica 0
+dies on its third chunk dispatch, mid-decode), a FLEET_REPLICAS=2
+deployment fails the dead replica's streams over to the survivor and
+completes 100% of them token-identically, while the single-replica
+deployment error-terminates every live stream — a replica crash costs
+latency, not output.
+
+Three arms over the same gpt2 service (random-init weights — failover
+economics depend on dispatch structure, not weights):
+
+- **single-clean**: FLEET_REPLICAS=1, no faults (the ceiling).
+- **single-kill**:  FLEET_REPLICAS=1, the kill schedule (unscoped —
+                    there is only one engine), SUPERVISE on but
+                    ENGINE_RESTARTS_MAX=0: the whole listener's
+                    streams die with the loop.
+- **fleet-kill**:   FLEET_REPLICAS=2, the r0-scoped kill schedule,
+                    ENGINE_RESTARTS_MAX=0: replica 0 dies the same
+                    death; its streams resume on replica 1.
+
+N streams arrive in two waves; each reports TTFT, tokens and whether
+it terminated cleanly (a mid-stream in-band ``error`` line counts as
+failed).  Goodput = tokens delivered by error-free streams / wall.
+
+    python benchmarks/replica_failover_ab.py              # current backend
+    DEVICE=cpu python benchmarks/replica_failover_ab.py   # CPU sanity run
+
+One JSON line per arm to stdout, a markdown table to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+from harness import ServiceUnderTest, pctile  # noqa: E402
+
+N_STREAMS = int(os.environ.get("FLEET_AB_N", "8"))
+KILL_AT = os.environ.get("FLEET_AB_KILL_AT", "3")
+
+PROMPTS = [
+    "the quick brown fox jumps",
+    "pack my box with five dozen",
+    "a longer prompt that spans a few more tokens than the others do",
+    "short one",
+]
+
+
+async def _one(client, i: int):
+    text = PROMPTS[i % len(PROMPTS)]
+    t0 = time.perf_counter()
+    try:
+        resp = await client.post(
+            "/predict",
+            json={"text": text, "stream": True,
+                  "max_tokens": 16 if i % 2 == 0 else 8},
+        )
+        if resp.status != 200:
+            await resp.read()
+            return {"ok": False, "status": resp.status, "tokens": 0}
+        ttft = None
+        n_tok = 0
+        failed = False
+        async for line in resp.content:
+            if not line.strip():
+                continue
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            row = json.loads(line)
+            if "error" in row:
+                failed = True
+                break
+            if row.get("done"):
+                n_tok = int(row.get("tokens_generated", 0))
+                break
+        return {"ok": not failed and n_tok > 0, "status": 200,
+                "tokens": 0 if failed else n_tok, "ttft": ttft}
+    except Exception:
+        return {"ok": False, "status": -1, "tokens": 0}
+
+
+async def run_arm(name: str, extra: dict, dev: dict) -> dict:
+    overrides = {
+        "MODEL_NAME": "gpt2",
+        "BATCH_BUCKETS": "1,4",
+        "SEQ_BUCKETS": "64",
+        "MAX_DECODE_LEN": "16",
+        "MAX_STREAMS": "4",
+        "MAX_STREAM_QUEUE": "16",
+        "WARMUP_SAMPLING": "0",
+        # Single-device placement on every arm: fleet replicas each
+        # own their engine (sharing a sharded mesh is gated), and the
+        # single-replica arms must be placement-comparable.
+        "REPLICAS": "1",
+        **extra,
+        **dev,
+    }
+    async with ServiceUnderTest(overrides) as s:
+        t0 = time.perf_counter()
+        first = asyncio.gather(
+            *(_one(s.client, i) for i in range(N_STREAMS // 2))
+        )
+        await asyncio.sleep(0.2)
+        second = asyncio.gather(
+            *(_one(s.client, i) for i in range(N_STREAMS // 2, N_STREAMS))
+        )
+        rows = (await first) + (await second)
+        wall = time.perf_counter() - t0
+        # Fleet introspection: how many replicas survived, failovers.
+        status = await (await s.client.get("/status")).json()
+        fleet = status.get("fleet") or {}
+        readyz = await s.client.get("/readyz")
+        ok = [r for r in rows if r["ok"]]
+        ttfts = [r["ttft"] for r in rows if r.get("ttft") is not None]
+        return {
+            "arm": name,
+            "offered": N_STREAMS,
+            "completed": len(ok),
+            "failed": N_STREAMS - len(ok),
+            "wall_s": round(wall, 2),
+            "goodput_tok_s": round(sum(r["tokens"] for r in ok) / wall, 1),
+            "p99_ttft_ms": round(pctile(ttfts, 0.99) * 1000, 1) if ttfts else None,
+            "replicas_healthy": fleet.get("healthy"),
+            "failovers": fleet.get("failovers"),
+            "readyz": readyz.status,
+        }
+
+
+async def main() -> None:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    kill_single = {
+        "FAULT_SPEC": f"chunk:fatal@{KILL_AT}",
+        "ENGINE_RESTARTS_MAX": "0",
+        "SUPERVISE": "1",
+    }
+    kill_fleet = {
+        "FLEET_REPLICAS": "2",
+        "FAULT_SPEC": f"r0:chunk:fatal@{KILL_AT}",
+        "ENGINE_RESTARTS_MAX": "0",
+        "SUPERVISE": "1",
+    }
+    rows = [
+        await run_arm("single-clean", {}, dev),
+        await run_arm("single-kill", kill_single, dev),
+        await run_arm("fleet-kill", kill_fleet, dev),
+    ]
+
+    import jax
+
+    backend = jax.default_backend()
+    print("\n| arm | completed | goodput tok/s | p99 TTFT (ms) | readyz "
+          "| wall (s) |", file=sys.stderr)
+    print("|---|---|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(
+            f"| {r['arm']} | {r['completed']}/{r['offered']} "
+            f"| {r['goodput_tok_s']} | {r['p99_ttft_ms']} "
+            f"| {r['readyz']} | {r['wall_s']} |",
+            file=sys.stderr,
+        )
+        print(json.dumps({**r, "kill_at": KILL_AT, "backend": backend}))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

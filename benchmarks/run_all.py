@@ -160,6 +160,16 @@ async def main() -> None:
             check=False,
         )
 
+    # Replica fleet (round-13 tentpole): goodput + p99 TTFT through a
+    # deterministic replica kill and recovery, FLEET_REPLICAS=2 with
+    # token-identical failover vs the single-replica blast radius.
+    # FLEET_AB=0 skips.
+    if os.environ.get("FLEET_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "replica_failover_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

@@ -1041,13 +1041,50 @@ async def handle_models(request: web.Request) -> web.Response:
 async def handle_healthz(request: web.Request) -> web.Response:
     """Liveness: stays 200 through drain (the process is healthy; it
     just stopped taking work) — only readiness flips."""
-    return web.json_response(
-        {"alive": True, "draining": request.app[K_BATCHER].draining}
-    )
+    body = {"alive": True, "draining": request.app[K_BATCHER].draining}
+    fleet = getattr(request.app[K_BATCHER], "fleet", None)
+    if fleet is not None:
+        body["fleet_healthy"] = len(fleet.healthy_replicas())
+        body["fleet_replicas"] = fleet.n
+    return web.json_response(body)
 
 
 async def handle_readyz(request: web.Request) -> web.Response:
-    sup = getattr(request.app[K_BATCHER], "supervisor", None)
+    batcher = request.app[K_BATCHER]
+    fleet = getattr(batcher, "fleet", None)
+    if fleet is not None:
+        # Fleet semantics: ready = ANY replica healthy.  One dead
+        # replica must not pull the listener out of the LB — its
+        # streams already failed over; degraded capacity is an
+        # explicit header, not an outage.
+        fleet.sweep()
+        healthy = len(fleet.healthy_replicas())
+        if healthy == 0:
+            ra = max(1, int(math.ceil(fleet.retry_after_s())))
+            return web.json_response(
+                {"ready": False,
+                 "error": "every fleet replica is dead",
+                 "fleet": {"healthy": 0, "replicas": fleet.n}},
+                status=503, headers={"Retry-After": str(ra)},
+            )
+        if batcher.draining:
+            return web.json_response(
+                {"ready": False, "draining": True}, status=503
+            )
+        if request.app[K_READY].is_set():
+            body = {"ready": True,
+                    "fleet": {"healthy": healthy, "replicas": fleet.n}}
+            headers = {}
+            if fleet.degraded:
+                body["degraded"] = True
+                headers["X-Fleet-Degraded"] = f"{healthy}/{fleet.n}"
+            return web.json_response(body, headers=headers)
+        body = {"ready": False}
+        err = request.app[K_STATE]["ready_error"]
+        if err:
+            body["error"] = err
+        return web.json_response(body, status=503)
+    sup = getattr(batcher, "supervisor", None)
     if sup is not None and sup.failed:
         # The engine crash-looped through its whole restart budget:
         # permanently unready so the LB stops routing here for good.
@@ -1057,7 +1094,7 @@ async def handle_readyz(request: web.Request) -> web.Response:
                       "(ENGINE_RESTARTS_MAX)"},
             status=503,
         )
-    if request.app[K_BATCHER].draining:
+    if batcher.draining:
         # Load balancers stop routing here while in-flight work drains.
         return web.json_response(
             {"ready": False, "draining": True}, status=503
@@ -1126,6 +1163,11 @@ async def handle_status(request: web.Request) -> web.Response:
     }
     if batcher.supervisor is not None:
         body["fault_tolerance"] = batcher.supervisor.stats()
+    fleet = getattr(batcher, "fleet", None)
+    if fleet is not None:
+        # Per-replica health/breaker/load detail + failover count
+        # (docs/replica-fleet.md).
+        body["fleet"] = fleet.status()
     cdl = getattr(batcher, "_cdl", None)
     if cdl is not None:
         # Decode dispatch shape: the auto-tuned chunk-chain pipelining

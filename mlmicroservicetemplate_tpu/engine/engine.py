@@ -47,11 +47,18 @@ def bucket_for(n: int, buckets: tuple[int, ...], multiple: int = 1) -> int:
 class InferenceEngine:
     """Owns jitted executables + on-device params for one ModelBundle."""
 
-    def __init__(self, bundle: ModelBundle, cfg, replicas: ReplicaSet | None = None):
+    def __init__(self, bundle: ModelBundle, cfg, replicas: ReplicaSet | None = None,
+                 replica_id: int = 0):
         import jax
 
         self.bundle = bundle
         self.cfg = cfg
+        # Fleet identity (engine/fleet.py): which data-parallel replica
+        # this engine is.  0 (default) = the single-engine path —
+        # unscoped FAULT_SPEC rules behave exactly as before, and
+        # ``rN:``-scoped rules let a chaos schedule kill one replica
+        # while the others stay clean.
+        self.replica_id = int(replica_id)
         # Fault tolerance (engine/faults.py): a deterministic injector
         # around the dispatch boundaries (FAULT_SPEC; None = off, zero
         # overhead) and a watchdog (deadline + transient retry) every
@@ -79,6 +86,7 @@ class InferenceEngine:
         self.faults = FaultInjector.from_spec(
             getattr(cfg, "fault_spec", None),
             int(getattr(cfg, "fault_seed", 0) or 0),
+            replica=self.replica_id,
         )
         self.watchdog = Watchdog(
             bundle.name,

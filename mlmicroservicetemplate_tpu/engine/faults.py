@@ -26,7 +26,9 @@ Two cooperating pieces, both OFF by default:
 
 FAULT_SPEC grammar (``;``-separated rules)::
 
-    rule   := [site ":"] kind ["(" seconds ")"] trigger
+    rule   := [replica ":"] [site ":"] kind ["(" seconds ")"] trigger
+    replica:= "r" N           rule applies only to fleet replica N
+                              (default: every replica, independently)
     site   := prefill | prefill_chunk | chunk | fetch | batch | grow | *
               (default *; prefill_chunk = one chunked-prefill window)
     kind   := transient | fatal | hang | oob
@@ -37,9 +39,13 @@ FAULT_SPEC grammar (``;``-separated rules)::
 ``seconds`` only applies to ``hang`` (default 3600).  Examples:
 ``chunk:fatal@5`` kills the 5th chunk dispatch;
 ``chunk:transient@2+3`` fails chunks 2-4 transiently;
-``*:transient~0.05`` fails 5% of all dispatches.  ``@N`` counters are
-per rule and count only dispatches at the rule's site, so a schedule
-is reproducible run-to-run regardless of thread timing.
+``*:transient~0.05`` fails 5% of all dispatches;
+``r1:chunk:fatal@3`` kills replica 1's 3rd chunk dispatch while every
+other replica stays clean (replica-scoped chaos — engine/fleet.py).
+``@N`` counters are per rule and count only dispatches at the rule's
+site ON the rule's replica (each replica engine owns its own injector
+with its own counters), so a schedule is reproducible run-to-run
+regardless of thread timing.
 """
 
 from __future__ import annotations
@@ -86,10 +92,12 @@ def is_fatal_device(exc: BaseException) -> bool:
 class FaultRule:
     """One parsed FAULT_SPEC rule with its own dispatch counter."""
 
-    __slots__ = ("site", "kind", "arg", "nth", "count", "rate", "seen", "fired")
+    __slots__ = ("site", "kind", "arg", "nth", "count", "rate", "seen",
+                 "fired", "replica")
 
     def __init__(self, site: str, kind: str, arg: float,
-                 nth: int = 0, count: int = 1, rate: float = 0.0):
+                 nth: int = 0, count: int = 1, rate: float = 0.0,
+                 replica: int | None = None):
         self.site = site
         self.kind = kind
         self.arg = arg
@@ -98,14 +106,20 @@ class FaultRule:
         self.rate = rate
         self.seen = 0
         self.fired = 0
+        # None = the rule applies on every replica (each replica's own
+        # injector counts it independently); an int scopes the rule to
+        # that fleet replica only (engine/fleet.py).
+        self.replica = replica
 
     def __repr__(self) -> str:  # shows up in logs when a fault fires
         trig = f"~{self.rate}" if self.rate else f"@{self.nth}+{self.count}"
-        return f"{self.site}:{self.kind}{trig}"
+        rep = f"r{self.replica}:" if self.replica is not None else ""
+        return f"{rep}{self.site}:{self.kind}{trig}"
 
 
 _RULE_RE = re.compile(
-    r"^(?:(?P<site>[a-z_*]+):)?"
+    r"^(?:r(?P<replica>\d+):)?"
+    r"(?:(?P<site>[a-z_*]+):)?"
     r"(?P<kind>[a-z]+)"
     r"(?:\((?P<arg>[0-9.]+)\))?"
     r"(?:@(?P<nth>\d+)(?:\+(?P<count>\d+))?|~(?P<rate>[0-9.]+))$"
@@ -134,12 +148,14 @@ def parse_spec(spec: str) -> list[FaultRule]:
         rate = float(m.group("rate") or 0.0)
         if not (0.0 <= rate <= 1.0):
             raise ValueError(f"FAULT_SPEC rate must be in [0, 1], got {rate}")
+        rep = m.group("replica")
         rules.append(FaultRule(
             site, kind,
             arg=float(m.group("arg") or 3600.0),
             nth=int(m.group("nth") or 0),
             count=int(m.group("count") or 1),
             rate=rate,
+            replica=int(rep) if rep is not None else None,
         ))
     return rules
 
@@ -159,10 +175,18 @@ class FaultInjector:
         self._lock = threading.Lock()
 
     @classmethod
-    def from_spec(cls, spec: str | None, seed: int = 0) -> "FaultInjector | None":
+    def from_spec(cls, spec: str | None, seed: int = 0,
+                  replica: int = 0) -> "FaultInjector | None":
+        """Build the injector for ONE engine: rules scoped to another
+        replica (``rN:`` prefix) are dropped here, so a fleet schedule
+        like ``r1:chunk:fatal@3`` kills replica 1 while replica 0's
+        injector never even sees the rule."""
         if not spec:
             return None
-        rules = parse_spec(spec)
+        rules = [
+            r for r in parse_spec(spec)
+            if r.replica is None or r.replica == int(replica)
+        ]
         return cls(rules, seed) if rules else None
 
     def fire(self, site: str) -> None:

@@ -1,0 +1,478 @@
+"""Replica fleet: N independent decode engines behind a health-gated
+router with token-identical failover.
+
+The r9 fault-tolerance layer made ONE engine survivable; this layer
+removes the remaining single blast radius — one wedged loop or one
+spent restart budget no longer takes down the whole listener (ROADMAP
+item 3; λScale-style data-parallel serving, arXiv 2502.09922).
+
+Topology: ``FLEET_REPLICAS`` fully independent replicas, each its own
+``InferenceEngine`` (own fault injector — ``rN:``-scoped FAULT_SPEC
+rules land on one replica only — own watchdog, own KV pool, own prefix
+cache, own flight recorder), its own ``ContinuousDecodeLoop``, its own
+``Supervisor`` and its own ``AdmissionController`` (per-replica
+pool-authoritative ledgers; the fleet splits ``KV_BUDGET_MB`` evenly
+so the replicas together honor one fleet budget).
+
+Routing (scheduler/router.py): health → prefix affinity →
+least-loaded, or round-robin under ``FLEET_ROUTE=rr``.
+
+Health has two layers:
+
+- The r9 **supervisor**: restart budget (optionally a sliding
+  window — ``ENGINE_RESTART_WINDOW_S``) spent → the replica is dead.
+- A per-replica **circuit breaker**: ``FLEET_BREAKER_N`` consecutive
+  dispatch faults open it (routing avoids the replica while its own
+  supervisor restarts churn); after half the eviction interval a
+  half-open probe re-admits traffic, and one clean dispatch closes it
+  again.  A breaker still open after ``FLEET_EVICT_S`` evicts the
+  replica outright.
+
+Failover — the robustness core: when a replica dies (restart budget
+spent, loop-thread death, or breaker eviction) its loop checkpoints
+EVERY pending and active stream at the delivered-token cursor
+(``streams._evacuate``), frees the corpse's pool blocks and prefix
+pins (the ledger drains to zero), and hands the checkpoints here; the
+fleet re-queues each on a healthy replica (``adopt_stream``), where
+the r7 recast/replay resume paths continue it **token-identically** —
+a replica crash costs latency, never output.
+
+``FLEET_REPLICAS=1`` (default) never constructs this class: the
+single-replica path is bit-identical to the pre-fleet engine.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..utils import metrics
+
+log = logging.getLogger(__name__)
+
+#: fleet_breaker_state gauge values.
+CLOSED, HALF_OPEN, OPEN, DEAD = 0, 1, 2, 3
+_STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open",
+                OPEN: "open", DEAD: "dead"}
+
+
+class CircuitBreaker:
+    """Consecutive-fault breaker for one replica.
+
+    closed → (``threshold`` consecutive faults) → open → (half the
+    eviction interval elapses) → half-open → one clean dispatch closes
+    it / one more fault re-opens it.  ``open_elapsed`` measures from
+    the FIRST transition out of closed, so flapping half-open probes
+    cannot reset the eviction clock.  Thread-safe; ``clock`` is
+    injectable for tests."""
+
+    def __init__(self, threshold: int = 3, evict_s: float = 10.0,
+                 clock=None):
+        self.threshold = max(1, int(threshold))
+        self.evict_s = max(0.0, float(evict_s))
+        self.probe_after_s = self.evict_s / 2.0
+        self._clock = clock if clock is not None else time.monotonic
+        self._state = CLOSED
+        self._streak = 0
+        self.faults = 0  # lifetime, observability
+        self._opened_at: float | None = None  # last open transition
+        self._first_open_at: float | None = None  # eviction clock
+        self._lock = threading.Lock()
+
+    def record_fault(self) -> None:
+        with self._lock:
+            if self._state == DEAD:
+                return
+            now = self._clock()
+            self.faults += 1
+            self._streak += 1
+            if self._state == HALF_OPEN or (
+                self._state == CLOSED and self._streak >= self.threshold
+            ):
+                self._state = OPEN
+                self._opened_at = now
+                if self._first_open_at is None:
+                    self._first_open_at = now
+            elif self._state == OPEN:
+                self._opened_at = now
+
+    def record_ok(self) -> None:
+        with self._lock:
+            if self._state == DEAD:
+                return
+            self._streak = 0
+            self._state = CLOSED
+            self._opened_at = None
+            self._first_open_at = None
+
+    def mark_dead(self) -> None:
+        with self._lock:
+            self._state = DEAD
+
+    def _state_locked(self) -> int:
+        if (
+            self._state == OPEN
+            and self._opened_at is not None
+            and self._clock() - self._opened_at >= self.probe_after_s
+        ):
+            self._state = HALF_OPEN
+        return self._state
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state_locked()
+
+    @property
+    def state_name(self) -> str:
+        return _STATE_NAMES[self.state]
+
+    def allow(self) -> bool:
+        """May the router send traffic here?  Closed always; half-open
+        admits probe traffic (a clean dispatch closes the breaker, a
+        fault re-opens it); open and dead never."""
+        return self.state in (CLOSED, HALF_OPEN)
+
+    def open_elapsed(self) -> float | None:
+        """Seconds since the breaker FIRST left closed (None while
+        closed) — the eviction clock."""
+        with self._lock:
+            if self._state == DEAD or self._first_open_at is None:
+                return None
+            return self._clock() - self._first_open_at
+
+    def retry_eta_s(self) -> float:
+        """Seconds until the next half-open probe window (the
+        Retry-After guidance an all-dead fleet returns)."""
+        with self._lock:
+            st = self._state_locked()
+            if st in (CLOSED, HALF_OPEN):
+                return 0.0
+            if st == DEAD or self._opened_at is None:
+                return self.probe_after_s or 1.0
+            return max(
+                0.0, self._opened_at + self.probe_after_s - self._clock()
+            )
+
+
+class Replica:
+    """One fleet member: engine + loop + supervisor + breaker."""
+
+    def __init__(self, rid: int, engine, cdl, supervisor, admission,
+                 breaker: CircuitBreaker):
+        self.id = rid
+        self.engine = engine
+        self.cdl = cdl
+        self.supervisor = supervisor
+        self.admission = admission
+        self.breaker = breaker
+        self.dead = False
+        self.dead_cause: str | None = None
+
+    def healthy(self) -> bool:
+        return (
+            not self.dead
+            and not self.cdl.dead
+            and not self.supervisor.failed
+            and not self.cdl._stop.is_set()
+            and self.breaker.allow()
+        )
+
+    def load(self) -> dict:
+        cdl = self.cdl
+        return {
+            "active": len(cdl.active),
+            "queued": cdl.queue.qsize(),
+            "prefilling": len(cdl._prefilling),
+            "kv_committed_bytes": self.admission.committed_bytes,
+        }
+
+
+class ReplicaFleet:
+    """The fleet: construction, routing, health sweeps, failover."""
+
+    def __init__(self, engine, cfg, clock=None):
+        from ..scheduler.admission import AdmissionController
+        from ..scheduler.router import Router
+        from .engine import InferenceEngine
+        from .streams import ContinuousDecodeLoop
+        from .supervisor import Supervisor
+
+        if getattr(cfg, "spec_continuous", False):
+            raise ValueError(
+                "FLEET_REPLICAS>1 does not compose with SPEC_CONTINUOUS "
+                "(the spec load gate counts streams across one loop)"
+            )
+        if getattr(engine.replicas, "n_devices", 1) > 1:
+            # Two engines dispatching sharded computations over ONE
+            # shared mesh interleave their collectives (each engine has
+            # its own pipeline semaphore, so nothing orders the
+            # all-gathers) — a silent rendezvous deadlock.  Fail at
+            # startup instead: fleet replicas each own a single-device
+            # placement (REPLICAS=1); per-replica device assignment is
+            # the λScale follow-up (ROADMAP item 3).
+            raise ValueError(
+                "FLEET_REPLICAS>1 requires a single-device replica "
+                "placement (set REPLICAS=1): independent engines must "
+                "not interleave collectives over one shared mesh"
+            )
+        self.cfg = cfg
+        self.model = engine.bundle.name
+        self.n = max(1, int(getattr(cfg, "fleet_replicas", 1)))
+        self.evict_s = float(getattr(cfg, "fleet_evict_s", 10.0) or 0.0)
+        breaker_n = int(getattr(cfg, "fleet_breaker_n", 3) or 3)
+        self.router = Router(getattr(cfg, "fleet_route", "least"))
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self.failovers = 0
+
+        # One fleet budget → per-replica pool-authoritative ledgers:
+        # each replica admits against its own share.
+        budget = float(getattr(cfg, "kv_budget_mb", 0.0) or 0.0)
+        split = self.n > 1 and budget > 0
+        per_cfg = (
+            cfg.model_copy(update={"kv_budget_mb": budget / self.n})
+            if split else cfg
+        )
+
+        self.replicas: list[Replica] = []
+        for r in range(self.n):
+            if r == 0 and not (split and getattr(engine, "paged_kv", False)):
+                # Reuse the already-built engine as replica 0 — unless
+                # its paged pool was sized for the WHOLE fleet budget,
+                # in which case it is rebuilt at the per-replica share.
+                eng = engine
+            else:
+                eng = InferenceEngine(
+                    engine.bundle, per_cfg, replicas=engine.replicas,
+                    replica_id=r,
+                )
+            cdl = ContinuousDecodeLoop(eng, per_cfg)
+            sup = Supervisor(per_cfg, recorder=eng.flight)
+            cdl.supervisor = sup
+            adm = AdmissionController(per_cfg, eng)
+            cdl.admission = adm
+            breaker = CircuitBreaker(breaker_n, self.evict_s, clock=clock)
+            rep = Replica(r, eng, cdl, sup, adm, breaker)
+            cdl.failover = self._failover_cb(rep)
+            cdl.on_fault = self._on_fault_cb(rep)
+            cdl.on_ok = breaker.record_ok
+            self.replicas.append(rep)
+        self._refresh_gauges()
+        log.info(
+            "replica fleet up: %d replicas, route=%s, breaker_n=%d, "
+            "evict_s=%.1f", self.n, self.router.policy, breaker_n,
+            self.evict_s,
+        )
+
+    # -- health --------------------------------------------------------
+
+    def healthy_replicas(self) -> list[Replica]:
+        return [r for r in self.replicas if r.healthy()]
+
+    @property
+    def degraded(self) -> bool:
+        """Some (not all) replicas are dead: still serving, at reduced
+        capacity — batch-class sheds first, /readyz stamps
+        X-Fleet-Degraded."""
+        dead = sum(1 for r in self.replicas if r.dead)
+        return 0 < dead < self.n
+
+    @property
+    def all_dead(self) -> bool:
+        return not self.healthy_replicas()
+
+    def retry_after_s(self) -> float:
+        """Retry-After guidance for an all-dead fleet: the nearest
+        breaker half-open ETA (plus any supervisor window slot that
+        frees sooner)."""
+        etas = []
+        for r in self.replicas:
+            etas.append(r.breaker.retry_eta_s())
+            w = r.supervisor.retry_eta_s()
+            if w > 0:
+                etas.append(w)
+        positive = [e for e in etas if e > 0]
+        return max(1.0, min(positive)) if positive else 1.0
+
+    def sweep(self) -> None:
+        """Evict replicas whose breaker sat open past FLEET_EVICT_S:
+        their streams hand over at the loop's next iteration top.
+        Called on every route, health probe and status read — no
+        background thread needed (a faulting replica also drives its
+        own supervisor/failover path from inside)."""
+        for rep in self.replicas:
+            if rep.dead:
+                continue
+            el = rep.breaker.open_elapsed()
+            if el is None or el < self.evict_s:
+                continue
+            t = rep.cdl._thread
+            if t is not None and t.is_alive() and not rep.cdl.dead:
+                rep.cdl.request_evacuation("evicted")
+            else:
+                # Nothing live to hand over: just retire it.
+                self._mark_dead(rep, "evicted")
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        for rep in self.replicas:
+            metrics.FLEET_BREAKER.labels(self.model, str(rep.id)).set(
+                DEAD if rep.dead else rep.breaker.state
+            )
+
+    # -- routing -------------------------------------------------------
+
+    @property
+    def max_prompt(self) -> int:
+        return self.replicas[0].cdl.max_prompt
+
+    def _shed(self, reason: str) -> None:
+        metrics.SHED.labels(self.model, reason).inc()
+
+    def submit_stream(self, feats: dict):
+        """Route one stream: health-gate, degraded policy, then the
+        router's ordering with shed fall-through (a replica at its own
+        queue bound does not fail the request while a sibling has
+        room)."""
+        from ..scheduler.policy import BATCH, QueueFullError
+
+        self.sweep()
+        healthy = self.healthy_replicas()
+        if not healthy:
+            self._shed("fleet_down")
+            raise QueueFullError(
+                "every fleet replica is dead",
+                reason="fleet_down", retry_after_s=self.retry_after_s(),
+            )
+        if self.degraded:
+            # Degraded capacity goes to the interactive class first:
+            # batch work sheds with honest Retry-After guidance.
+            klass, _ = healthy[0].admission.classify(feats)
+            if klass == BATCH:
+                self._shed("degraded")
+                raise QueueFullError(
+                    "fleet degraded (dead replica): batch class sheds "
+                    "first", reason="degraded",
+                    retry_after_s=self.retry_after_s(),
+                )
+        last_err = None
+        for rep in self.router.order(healthy, feats):
+            try:
+                return rep.cdl.submit_stream(feats)
+            except (QueueFullError, RuntimeError) as e:
+                # QueueFullError: this replica is at its own bound —
+                # fall through to a sibling with room.  RuntimeError:
+                # the replica died between the health check and the
+                # submit (its loop refuses new streams); same answer.
+                last_err = e
+        raise last_err
+
+    # -- failover ------------------------------------------------------
+
+    def _on_fault_cb(self, rep: Replica):
+        def on_fault():
+            rep.breaker.record_fault()
+            self._refresh_gauges()
+        return on_fault
+
+    def _mark_dead(self, rep: Replica, cause: str) -> None:
+        rep.dead = True
+        rep.dead_cause = cause
+        rep.breaker.mark_dead()
+
+    def _failover_cb(self, rep: Replica):
+        """The callback ``streams._evacuate`` invokes with the dead
+        replica's stream checkpoints (on the dying loop's thread)."""
+
+        def failover(streams, exc, cause):
+            with self._lock:
+                self._mark_dead(rep, cause)
+                self.failovers += 1
+            metrics.FLEET_FAILOVERS.labels(
+                self.model, str(rep.id), cause
+            ).inc()
+            healthy = self.healthy_replicas()
+            moved = lost = 0
+            for st in streams:
+                target = self.router.pick_adopter(healthy)
+                if target is None:
+                    st.emit(
+                        exc if isinstance(exc, Exception)
+                        else RuntimeError(f"replica {rep.id} died: {exc}")
+                    )
+                    lost += 1
+                    continue
+                target.cdl.adopt_stream(st)
+                moved += 1
+            if moved:
+                metrics.STREAMS_RECOVERED.labels(
+                    self.model, str(rep.id), "failover"
+                ).inc(moved)
+            if lost:
+                metrics.STREAMS_LOST.labels(
+                    self.model, str(rep.id), "no_replica"
+                ).inc(lost)
+            self._refresh_gauges()
+            log.warning(
+                "replica %d failover (%s): %d stream(s) re-routed, "
+                "%d lost, %d healthy replica(s) remain",
+                rep.id, cause, moved, lost, len(healthy),
+            )
+
+        return failover
+
+    # -- lifecycle -----------------------------------------------------
+
+    def warm(self) -> None:
+        for rep in self.replicas:
+            rep.cdl.warm()
+
+    def begin_drain(self) -> None:
+        for rep in self.replicas:
+            rep.admission.draining = True
+
+    @property
+    def draining(self) -> bool:
+        return any(r.admission.draining for r in self.replicas)
+
+    def admitted(self) -> int:
+        return sum(r.cdl._admitted for r in self.replicas)
+
+    def pending_work(self) -> int:
+        return sum(
+            r.cdl._admitted + len(r.cdl._inflight_chunks)
+            for r in self.replicas
+        )
+
+    def stop(self) -> None:
+        for rep in self.replicas:
+            rep.cdl.stop()
+
+    # -- observability -------------------------------------------------
+
+    def status(self) -> dict:
+        self.sweep()
+        healthy = self.healthy_replicas()
+        return {
+            "replicas": self.n,
+            "route": self.router.policy,
+            "healthy": len(healthy),
+            "dead": sum(1 for r in self.replicas if r.dead),
+            "degraded": self.degraded,
+            "failovers": self.failovers,
+            "per_replica": [
+                {
+                    "id": r.id,
+                    "healthy": r.healthy(),
+                    "breaker": (
+                        "dead" if r.dead else r.breaker.state_name
+                    ),
+                    "dead_cause": r.dead_cause,
+                    "load": r.load(),
+                    "supervisor": r.supervisor.stats(),
+                }
+                for r in self.replicas
+            ],
+        }

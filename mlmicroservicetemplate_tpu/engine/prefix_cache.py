@@ -104,6 +104,20 @@ class PrefixCache:
             self.misses += 1
             return None
 
+    def peek(self, ids: np.ndarray, length: int) -> int:
+        """Longest cached prefix bucket of ``ids[:length]`` WITHOUT
+        touching stats or LRU recency — the fleet router's
+        prefix-affinity probe (scheduler/router.py) must not register
+        hits on replicas the request never routes to.  Returns 0 on
+        no match."""
+        with self._lock:
+            for p in reversed(self.buckets):
+                if p > length - 1:
+                    continue
+                if (p, _key(ids, p)) in self._entries:
+                    return p
+            return 0
+
     def bucket_for_insert(self, length: int) -> int | None:
         """Largest bucket ≤ length-1 (the most reusable prefix a prompt
         of this length can donate), or None when it's too short."""

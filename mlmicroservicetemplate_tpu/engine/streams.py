@@ -412,6 +412,21 @@ class ContinuousDecodeLoop:
         # tests, SUPERVISE=0) keeps the historical error-every-stream
         # behavior.
         self.supervisor = None
+        # Fleet wiring (engine/fleet.py; all None/unset outside a
+        # fleet — the single-replica path never touches them):
+        # ``failover(streams, exc, cause)`` receives every live
+        # stream's checkpoint when this loop dies (restart budget
+        # spent, loop-thread death, or breaker eviction) instead of
+        # error-terminating them; ``on_fault``/``on_ok`` feed the
+        # replica's circuit breaker; ``request_evacuation`` asks the
+        # loop to hand everything over at the next iteration top.
+        self.replica_id = int(getattr(engine, "replica_id", 0))
+        self.failover = None
+        self.on_fault = None
+        self.on_ok = None
+        self.dead = False
+        self._evacuate_req = threading.Event()
+        self._evict_cause = "evicted"
         # A fatal fault detected off the loop's main try (e.g. during
         # a prefill whose streams were checkpoint-requeued in place):
         # raised at the next iteration top so the shared recovery path
@@ -651,6 +666,17 @@ class ContinuousDecodeLoop:
 
     def _abort_all(self, exc: BaseException) -> None:
         """Terminal error to every queued, pending and active stream."""
+        if self.failover is not None and not self.dead:
+            # Fleet mode: even a loop-thread death hands its streams
+            # over instead of stranding them (the "one wedged loop
+            # takes down the listener" failure this layer removes).
+            try:
+                self._evacuate(exc, "loop_death")
+                return
+            except Exception:
+                log.exception(
+                    "failover evacuation failed; error-terminating"
+                )
         for st, *_ in self._pending_admissions:
             self._finish(st, exc)
         self._pending_admissions = []
@@ -678,6 +704,15 @@ class ContinuousDecodeLoop:
         log.info("continuous decode loop up: %d slots", self.n_slots)
         while not self._stop.is_set():
             try:
+                # The fleet asked for this replica's streams (breaker
+                # open past FLEET_EVICT_S): evacuate at this iteration
+                # top — a clean boundary, nothing in flight is lost.
+                if self._evacuate_req.is_set() and self.failover is not None:
+                    self._evacuate(
+                        StreamClosedError("replica evicted by the fleet"),
+                        self._evict_cause,
+                    )
+                    continue
                 # A fatal fault parked by the prefill path (its streams
                 # already checkpoint-requeued): run the shared recovery
                 # now, with clean pending lists.
@@ -819,6 +854,18 @@ class ContinuousDecodeLoop:
             except Exception as e:
                 if self._recover(e):
                     continue
+                if self.failover is not None:
+                    # Fleet mode: instead of error-terminating, hand
+                    # every live stream's checkpoint to a healthy
+                    # replica for token-identical resume.
+                    cause = (
+                        "budget"
+                        if self.supervisor is not None
+                        and self.supervisor.failed
+                        else "fault"
+                    )
+                    self._evacuate(e, cause)
+                    continue
                 log.exception("decode loop iteration failed")
                 n_lost = 0
                 for st, *_ in self._pending_admissions:
@@ -842,7 +889,8 @@ class ContinuousDecodeLoop:
                     self._free_slot(slot)
                 if n_lost:
                     metrics.STREAMS_LOST.labels(
-                        self.engine.bundle.name
+                        self.engine.bundle.name, str(self.replica_id),
+                        "fault",
                     ).inc(n_lost)
                 # A failed dispatch may have already consumed (donated)
                 # the state buffers — rebuild lazily on next admission.
@@ -952,6 +1000,11 @@ class ContinuousDecodeLoop:
         admission.  Returns False — caller error-terminates everything
         — when no supervisor is attached or the restart budget is
         spent."""
+        if self.on_fault is not None:
+            # Feed the replica's circuit breaker (engine/fleet.py)
+            # BEFORE deciding recoverability: consecutive faults open
+            # the breaker even while the restart budget still grants.
+            self.on_fault()
         sup = self.supervisor
         if sup is None or not sup.allow_restart():
             # Unrecoverable (no supervisor, or the budget is spent and
@@ -1017,12 +1070,127 @@ class ContinuousDecodeLoop:
             self.admission.note_pool()
         metrics.ENGINE_RESTARTS.labels(eng.bundle.name).inc()
         if recovered:
-            metrics.STREAMS_RECOVERED.labels(eng.bundle.name).inc(recovered)
+            metrics.STREAMS_RECOVERED.labels(
+                eng.bundle.name, str(self.replica_id), "restart"
+            ).inc(recovered)
         log.info(
             "engine rebuilt; %d stream checkpoint(s) requeued for "
             "token-identical resume", recovered,
         )
         return True
+
+    # -- fleet failover (engine/fleet.py) ------------------------------
+
+    def request_evacuation(self, cause: str = "evicted") -> None:
+        """Ask the loop to hand every live stream to the fleet at the
+        next iteration top (breaker-eviction path; thread-safe)."""
+        self._evict_cause = cause
+        self._evacuate_req.set()
+
+    def _inc_admitted(self) -> None:
+        self._admitted += 1
+
+    def adopt_stream(self, st: _Stream) -> None:
+        """Failover entry: enqueue another replica's checkpointed
+        stream here for token-identical resume.  The checkpoint is
+        just feats + cursor (``_checkpoint_for_resume``), so adoption
+        is ordinary re-admission: re-estimate the KV footprint against
+        THIS replica's pool, count it against this loop's admission,
+        queue it.  Called from the dead replica's loop thread."""
+        if self.admission is not None:
+            st.kv = self.admission.kv_bytes_for_resume(st.feats)
+        try:
+            st.loop.call_soon_threadsafe(self._inc_admitted)
+        except RuntimeError:
+            self._admitted += 1
+        if self._flight is not None:
+            self._flight.event(
+                "adopt_stream", rid=st.rid, klass=st.klass,
+                budget=st.budget, skip=st.skip,
+            )
+        st.t_queued = time.monotonic()
+        self.queue.put(st, force=True)
+        self._ensure_thread()
+
+    def _harvest_checkpoint(self, st: _Stream) -> _Stream | None:
+        """Checkpoint one stream for failover: release this replica's
+        ledger hold and admission count (the adopter re-takes both),
+        or end the stream if nothing remains to resume."""
+        if self.admission is not None:
+            self.admission.release(st)
+        if not self._checkpoint_for_resume(st):
+            self._finish(st)
+            return None
+        try:
+            st.loop.call_soon_threadsafe(self._dec_admitted)
+        except RuntimeError:
+            self._admitted -= 1
+        return st
+
+    def _evacuate(self, exc: BaseException, cause: str) -> None:
+        """This replica is dead (restart budget spent, loop death, or
+        breaker eviction): checkpoint EVERY pending and active stream
+        at its delivered-token cursor, free every device resource the
+        corpse holds (blocks, prefix pins — the pool ledger must drain
+        to zero), stop the loop, and hand the checkpoints to the fleet
+        for token-identical resume on a healthy replica.  A replica
+        crash costs latency, never output."""
+        self.dead = True
+        self._stop.set()
+        harvested: list[_Stream] = []
+
+        def h(st: _Stream) -> None:
+            out = self._harvest_checkpoint(st)
+            if out is not None:
+                harvested.append(out)
+
+        for st, *_ in self._pending_admissions:
+            h(st)
+        self._pending_admissions = []
+        for st in self._pending_wave:
+            h(st)
+        self._pending_wave = []
+        for job in self._prefilling:
+            # Real frees, not the _recover deref: the pool outlives
+            # this loop and its ledger must read zero afterward.
+            self._drop_job_resources(job)
+            h(job.st)
+        self._prefilling = []
+        for st in self.queue.drain_all():
+            h(st)
+        for slot in list(self.active):
+            st = self.active.pop(slot)
+            self._release_blocks(slot, st)
+            h(st)
+        self.sampled_slots.clear()
+        self.free = list(range(self.n_slots))
+        self._inflight_chunks.clear()
+        self._state = None
+        # Drop the dead replica's prefix-cache pins: nothing will ever
+        # serve from them again, and they are the last refs keeping
+        # pool blocks from draining to zero.
+        eng = self.engine
+        if self.paged and eng.prefix_cache is not None:
+            while eng.prefix_cache.pop_lru() is not None:
+                pass
+        if self._flight is not None:
+            self._flight.event(
+                "failover", cause=cause, streams=len(harvested),
+                replica=self.replica_id,
+            )
+            self._flight.dump(
+                f"replica {self.replica_id} dead ({cause}): "
+                f"{type(exc).__name__}: {exc}"
+            )
+        log.warning(
+            "replica %d dead (%s): evacuating %d stream checkpoint(s) "
+            "to the fleet", self.replica_id, cause, len(harvested),
+        )
+        if self.failover is not None:
+            self.failover(harvested, exc, cause)
+        else:  # defensive: no fleet attached — error-terminate
+            for st in harvested:
+                st.emit(exc)
 
     # -- preemption ----------------------------------------------------
 
@@ -1078,8 +1246,10 @@ class ContinuousDecodeLoop:
             # straight back to the batch class we just preempted.
             self.queue.prefer_interactive()
 
-    def _requeue_preempted(self, st: _Stream) -> None:
-        """Checkpoint + re-queue one preempted stream.
+    def _checkpoint_for_resume(self, st: _Stream) -> bool:
+        """Prepare one stream's token-identical resume off its
+        delivered-token cursor; False when there is nothing left to
+        resume (finished or cancelled — the caller just ends it).
 
         Two token-identical resume strategies:
         - **Recast** (decoder-only causal LMs, greedy): the remaining
@@ -1091,11 +1261,15 @@ class ContinuousDecodeLoop:
         - **Replay** (everything else): re-run the whole deterministic
           generation and suppress the first ``skip`` tokens.  Costs
           recompute, works for any family (encoder-decoders cannot
-          re-enter decoder history through admission)."""
+          re-enter decoder history through admission).
+
+        The checkpoint is engine-agnostic — the feats dict plus a
+        cursor — which is exactly why a FLEET failover can hand it to
+        a DIFFERENT replica's queue and still resume token-identically
+        (engine/fleet.py)."""
         remaining = st.budget - st.produced
         if remaining <= 0 or st.cancelled.is_set():
-            self._finish(st)
-            return
+            return False
         st.started = True
         st.preempted += 1
         greedy = float(st.feats.get("temperature", 0.0)) == 0.0
@@ -1122,14 +1296,23 @@ class ContinuousDecodeLoop:
             st.skip = len(st.tokens)
         st.produced = 0
         # A checkpointed stream holds NO ledger commitment while it
-        # waits (its reservation was released above by the caller);
-        # refresh the footprint it will re-reserve at dequeue — the
-        # recast path just FOLDED delivered tokens into the prompt, so
-        # the stale admission-time estimate can undershoot the new
-        # prompt bucket.
+        # waits (its reservation was released above by the caller).
         st.blocks = None
         st.shared_ids = []
         st.s_lo = st.s_base = 0
+        return True
+
+    def _requeue_preempted(self, st: _Stream) -> None:
+        """Checkpoint + re-queue one preempted stream on THIS loop's
+        own queue (see ``_checkpoint_for_resume`` for the resume
+        strategies)."""
+        if not self._checkpoint_for_resume(st):
+            self._finish(st)
+            return
+        # Refresh the footprint the stream re-reserves at dequeue —
+        # the recast path just FOLDED delivered tokens into the
+        # prompt, so the stale admission-time estimate can undershoot
+        # the new prompt bucket.
         if self.admission is not None:
             st.kv = self.admission.kv_bytes_for_resume(st.feats)
         if self._flight is not None:
@@ -2689,6 +2872,10 @@ class ContinuousDecodeLoop:
         else:
             toks_np, done_np = fetched
             self._route_chunk(toks_np, done_np, snapshot)
+        if self.on_ok is not None:
+            # One successfully fetched-and-routed dispatch closes the
+            # replica's breaker fault streak (engine/fleet.py).
+            self.on_ok()
 
     def _deliver_oldest(self) -> None:
         import jax

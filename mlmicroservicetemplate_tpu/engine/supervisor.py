@@ -8,25 +8,50 @@ checkpoints for token-identical resume (``streams._recover``).  This
 object holds the POLICY: how many rebuilds a process may spend before
 it declares itself broken.
 
+Two budget shapes:
+
+- **Lifetime** (``ENGINE_RESTART_WINDOW_S=0``, the historical
+  default): ``ENGINE_RESTARTS_MAX`` rebuilds total, ever.  Once
+  ``failed`` flips it stays flipped — a crash-looping engine must fall
+  out of the load balancer instead of flapping.
+- **Sliding window** (``ENGINE_RESTART_WINDOW_S>0``): the cap counts
+  only restarts inside the trailing window, so a replica that ate a
+  burst of faults hours ago is not permanently condemned — exactly
+  what a long-lived fleet replica needs.  ``retry_eta_s`` reports when
+  the oldest in-window restart expires (the Retry-After guidance for
+  an all-dead fleet).
+
 Once ``failed`` flips, the loop stops, every remaining consumer gets
-a terminal error, new submissions are refused, and ``/readyz`` goes
-permanently unready — a crash-looping engine must fall out of the
-load balancer instead of flapping."""
+a terminal error (or, under a fleet, fails over to a healthy
+replica — engine/fleet.py), new submissions are refused, and
+``/readyz`` goes unready."""
 
 from __future__ import annotations
 
 import threading
+import time
 
 
 class Supervisor:
     """Bounded-restart policy shared by the decode loop and /readyz."""
 
     def __init__(self, cfg=None, max_restarts: int | None = None,
-                 recorder=None):
+                 recorder=None, window_s: float | None = None,
+                 clock=None):
         if max_restarts is None:
             max_restarts = int(getattr(cfg, "engine_restarts_max", 3) or 0)
+        if window_s is None:
+            window_s = float(
+                getattr(cfg, "engine_restart_window_s", 0.0) or 0.0
+            )
         self.max_restarts = max(0, int(max_restarts))
-        self.restarts = 0
+        # 0 = lifetime budget (the seed semantics); >0 = sliding window.
+        self.window_s = max(0.0, float(window_s))
+        # Injectable clock so window behavior is pinned by tests
+        # without sleeping through real windows.
+        self._clock = clock if clock is not None else time.monotonic
+        self.restarts = 0  # lifetime count (observability either mode)
+        self._times: list[float] = []  # in-window restart stamps
         self.failed = False
         # Optional flight recorder (utils/tracing.FlightRecorder): the
         # ring dumps the moment a restart is granted or refused, so
@@ -35,20 +60,35 @@ class Supervisor:
         self.recorder = recorder
         self._lock = threading.Lock()
 
+    def _prune_locked(self, now: float) -> None:
+        if self.window_s > 0:
+            cutoff = now - self.window_s
+            self._times = [t for t in self._times if t > cutoff]
+
     def allow_restart(self) -> bool:
         """Spend one restart from the budget; False (and ``failed``)
-        once it is exhausted."""
+        once it is exhausted.  In window mode only in-window restarts
+        count against the cap — a refusal still flips ``failed`` (the
+        loop is dead either way), but the window occupancy stays
+        visible for Retry-After guidance."""
         with self._lock:
-            if self.failed or self.restarts >= self.max_restarts:
+            now = self._clock()
+            self._prune_locked(now)
+            used = len(self._times) if self.window_s > 0 else self.restarts
+            if self.failed or used >= self.max_restarts:
                 first = not self.failed
                 self.failed = True
                 if self.recorder is not None and first:
                     self.recorder.dump(
                         "engine restart budget exhausted "
-                        f"({self.restarts}/{self.max_restarts})"
+                        f"({used}/{self.max_restarts}"
+                        + (f" in {self.window_s:.0f}s window)"
+                           if self.window_s > 0 else ")")
                     )
                 return False
             self.restarts += 1
+            if self.window_s > 0:
+                self._times.append(now)
             if self.recorder is not None:
                 self.recorder.event(
                     "engine_restart", n=self.restarts,
@@ -56,10 +96,42 @@ class Supervisor:
                 )
             return True
 
+    def window_used(self) -> int:
+        """Restarts currently counting against the budget."""
+        with self._lock:
+            if self.window_s <= 0:
+                return self.restarts
+            self._prune_locked(self._clock())
+            return len(self._times)
+
+    def retry_eta_s(self) -> float:
+        """Seconds until a restart slot frees (window mode; 0 when a
+        slot is already free or the budget is a lifetime cap)."""
+        with self._lock:
+            if self.window_s <= 0:
+                return 0.0
+            now = self._clock()
+            self._prune_locked(now)
+            if len(self._times) < self.max_restarts or not self._times:
+                return 0.0
+            return max(0.0, self._times[0] + self.window_s - now)
+
     def stats(self) -> dict:
         with self._lock:
-            return {
+            now = self._clock()
+            self._prune_locked(now)
+            out = {
                 "restarts": self.restarts,
                 "max_restarts": self.max_restarts,
                 "failed": self.failed,
             }
+            if self.window_s > 0:
+                out["window_s"] = self.window_s
+                out["window_used"] = len(self._times)
+                out["window_free_in_s"] = round(
+                    max(0.0, self._times[0] + self.window_s - now)
+                    if len(self._times) >= self.max_restarts and self._times
+                    else 0.0,
+                    3,
+                )
+            return out

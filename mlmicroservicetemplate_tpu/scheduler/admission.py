@@ -41,6 +41,11 @@ class AdmissionController:
         self.model = getattr(
             getattr(engine, "bundle", None), "name", "unknown"
         )
+        # Fleet replica label for the committed-KV gauges: each replica
+        # runs its OWN controller over its OWN pool — per-replica
+        # pool-authoritative ledgers under one fleet budget (the fleet
+        # splits KV_BUDGET_MB across replicas; engine/fleet.py).
+        self.replica = str(getattr(engine, "replica_id", 0))
         default = str(
             getattr(cfg, "priority_default", INTERACTIVE) or INTERACTIVE
         ).lower()
@@ -80,15 +85,15 @@ class AdmissionController:
     def note_pool(self) -> None:
         """Refresh the committed-bytes gauge off the pool (paged)."""
         if self.paged and self.pool:
-            metrics.KV_COMMITTED.labels(self.model).set(
+            metrics.KV_COMMITTED.labels(self.model, self.replica).set(
                 self._committed + self.pool.used_bytes
             )
-            metrics.KV_POOL_BLOCKS.labels(self.model, "used").set(
-                self.pool.used_blocks
-            )
-            metrics.KV_POOL_BLOCKS.labels(self.model, "free").set(
-                self.pool.free_blocks
-            )
+            metrics.KV_POOL_BLOCKS.labels(
+                self.model, self.replica, "used"
+            ).set(self.pool.used_blocks)
+            metrics.KV_POOL_BLOCKS.labels(
+                self.model, self.replica, "free"
+            ).set(self.pool.free_blocks)
 
     # -- classification ------------------------------------------------
 
@@ -203,7 +208,7 @@ class AdmissionController:
         if kv and not item.kv_held:
             with self._lock:
                 self._committed += kv
-                metrics.KV_COMMITTED.labels(self.model).set(
+                metrics.KV_COMMITTED.labels(self.model, self.replica).set(
                     self._committed + self._pool_bytes()
                 )
             item.kv_held = True
@@ -215,7 +220,7 @@ class AdmissionController:
         if getattr(item, "kv_held", False):
             with self._lock:
                 self._committed -= item.kv
-                metrics.KV_COMMITTED.labels(self.model).set(
+                metrics.KV_COMMITTED.labels(self.model, self.replica).set(
                     self._committed + self._pool_bytes()
                 )
             item.kv_held = False

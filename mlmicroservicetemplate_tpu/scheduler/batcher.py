@@ -127,26 +127,55 @@ class Batcher:
         # engine rebuilds on fatal dispatch faults.  /readyz reads the
         # ``failed`` flag once the restart budget is spent.
         self.supervisor = None
+        # Replica fleet (FLEET_REPLICAS>1; engine/fleet.py): N
+        # independent decode loops behind a health-gated router with
+        # token-identical failover.  None (the default) keeps the
+        # single-loop path below, bit-identical to the pre-fleet code.
+        self.fleet = None
+        fleet_n = int(getattr(cfg, "fleet_replicas", 1) or 1)
         if getattr(engine.bundle, "kind", None) == "seq2seq" and getattr(
             cfg, "continuous_batching", True
         ):
-            from ..engine.streams import ContinuousDecodeLoop
+            if fleet_n > 1:
+                from ..engine.fleet import ReplicaFleet
 
-            self._cdl = ContinuousDecodeLoop(engine, cfg)
-            # MAX_STREAMS caps TOTAL concurrent generations: each side
-            # counts the other's active streams in its admission check.
-            self._cdl.external_active = lambda: self._active_streams
-            # One admission controller (and KV ledger) for both queues.
-            self._cdl.admission = self.admission
-            if getattr(cfg, "supervise", True):
-                from ..engine.supervisor import Supervisor
+                self.fleet = ReplicaFleet(engine, cfg)
+                for rep in self.fleet.replicas:
+                    # MAX_STREAMS caps concurrent generations PER
+                    # replica; legacy per-stream traffic counts
+                    # against every replica's bound.
+                    rep.cdl.external_active = lambda: self._active_streams
+                # Introspection compatibility: /status.decode and
+                # /debug/engine read replica 0's loop; per-replica
+                # detail lives in /status.fleet.
+                self._cdl = self.fleet.replicas[0].cdl
+                self.supervisor = self.fleet.replicas[0].supervisor
+            else:
+                from ..engine.streams import ContinuousDecodeLoop
 
-                # The supervisor dumps the engine flight recorder the
-                # moment it grants (or refuses) a restart.
-                self.supervisor = Supervisor(
-                    cfg, recorder=getattr(engine, "flight", None)
-                )
-                self._cdl.supervisor = self.supervisor
+                self._cdl = ContinuousDecodeLoop(engine, cfg)
+                # MAX_STREAMS caps TOTAL concurrent generations: each
+                # side counts the other's active streams in its
+                # admission check.
+                self._cdl.external_active = lambda: self._active_streams
+                # One admission controller (KV ledger) for both queues.
+                self._cdl.admission = self.admission
+                if getattr(cfg, "supervise", True):
+                    from ..engine.supervisor import Supervisor
+
+                    # The supervisor dumps the engine flight recorder
+                    # the moment it grants (or refuses) a restart.
+                    self.supervisor = Supervisor(
+                        cfg, recorder=getattr(engine, "flight", None)
+                    )
+                    self._cdl.supervisor = self.supervisor
+        elif fleet_n > 1 and getattr(
+            engine.bundle, "kind", None
+        ) == "seq2seq":
+            raise ValueError(
+                "FLEET_REPLICAS>1 requires CONTINUOUS_BATCHING=1 (the "
+                "fleet replicates the continuous decode loop)"
+            )
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -161,7 +190,11 @@ class Batcher:
             self._task = None
         if self._inflight:
             await asyncio.gather(*self._inflight, return_exceptions=True)
-        if self._cdl is not None:
+        if self.fleet is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.fleet.stop
+            )
+        elif self._cdl is not None:
             await asyncio.get_running_loop().run_in_executor(None, self._cdl.stop)
         self._executor.shutdown(wait=False)
         self._stream_executor.shutdown(wait=False)
@@ -170,7 +203,9 @@ class Batcher:
         """Blocking: compile the continuous-batching executables (slot
         insert, batched chunk) so the first stream pays no compiles.
         Called from the app's warmup executor, after engine.warmup."""
-        if self._cdl is not None:
+        if self.fleet is not None:
+            self.fleet.warm()
+        elif self._cdl is not None:
             self._cdl.warm()
 
     # ------------------------------------------------------------------
@@ -180,6 +215,8 @@ class Batcher:
         """Stop admitting (new work sheds 503 ``drain``); everything
         already queued or in flight runs to completion."""
         self.admission.draining = True
+        if self.fleet is not None:
+            self.fleet.begin_drain()
 
     @property
     def draining(self) -> bool:
@@ -188,7 +225,9 @@ class Batcher:
     def pending_work(self) -> int:
         """Admitted-but-unfinished items across both serving paths."""
         n = self._queue.qsize() + len(self._inflight) + self._active_streams
-        if self._cdl is not None:
+        if self.fleet is not None:
+            n += self.fleet.pending_work()
+        elif self._cdl is not None:
             n += self._cdl._admitted + len(self._cdl._inflight_chunks)
         return n
 
@@ -214,7 +253,9 @@ class Batcher:
         from current depth × the observed service-time EWMA."""
         if streams:
             waiting = self._active_streams
-            if self._cdl is not None:
+            if self.fleet is not None:
+                waiting += self.fleet.admitted()
+            elif self._cdl is not None:
                 waiting += self._cdl._admitted
             est = (waiting + 1) * self._stream_ewma_s / max(1, self.max_streams)
         else:
@@ -316,7 +357,11 @@ class Batcher:
         ):
             # Deadline-queued admission (and preemption) live in the
             # continuous loop; it raises QueueFullError / emits
-            # DeadlineExceededError itself.
+            # DeadlineExceededError itself.  Under a fleet the router
+            # picks the replica (health → affinity → least-loaded)
+            # and its loop does the same admission.
+            if self.fleet is not None:
+                return self.fleet.submit_stream(feats)
             return self._cdl.submit_stream(feats)
         # Legacy per-stream path (oversized prompts, spec routing, or
         # CONTINUOUS_BATCHING=0): the worker pool admits instantly or
@@ -334,7 +379,10 @@ class Batcher:
         # join the shared slot batch; they keep the per-stream path —
         # but MAX_STREAMS caps TOTAL concurrent generations, so count
         # the loop's admissions too.
-        cdl_active = self._cdl._admitted if self._cdl is not None else 0
+        cdl_active = (
+            self.fleet.admitted() if self.fleet is not None
+            else self._cdl._admitted if self._cdl is not None else 0
+        )
         if self._active_streams + cdl_active >= self.max_streams:
             self._shed("queue_full")
             raise QueueFullError(

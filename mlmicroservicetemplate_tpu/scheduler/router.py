@@ -1,0 +1,98 @@
+"""Fleet routing policy: which replica serves this request.
+
+Pure policy over a list of candidate replicas (engine/fleet.py owns
+the replicas themselves), so the ordering rules are unit-testable
+without engines.  The decision ladder, per the λScale-style
+data-parallel serving design (arXiv 2502.09922):
+
+1. **Health** — the fleet hands this router only replicas whose
+   breaker admits traffic (closed, or half-open probing); dead and
+   open-breaker replicas never appear.
+2. **Prefix affinity** — a prompt whose cached prefix lives on some
+   replica's prefix cache routes there: the hit saves the whole
+   prefix prefill, worth far more than marginal load spread.  Probed
+   with ``PrefixCache.peek`` (non-mutating — a probe must not skew
+   hit stats or LRU recency on replicas the request never reaches).
+   Ties (same longest prefix bucket) break by load.
+3. **Least-loaded** — committed KV bytes (the pool-authoritative
+   ledger) plus queue depth, normalized so neither term drowns the
+   other.
+
+``FLEET_ROUTE=rr`` replaces 2-3 with plain round-robin over the
+healthy set — the A/B baseline that shows what affinity+load buy.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+ROUTE_LEAST = "least"
+ROUTE_RR = "rr"
+
+
+def replica_load(replica) -> float:
+    """Load score: committed KV bytes (normalized to blocks-ish scale)
+    + waiting/active stream count.  Works on any object exposing
+    ``cdl`` (queue + active) and an optional admission controller."""
+    cdl = replica.cdl
+    n = len(cdl.active) + cdl.queue.qsize() + len(cdl._prefilling)
+    adm = getattr(cdl, "admission", None)
+    kv = float(adm.committed_bytes) if adm is not None else 0.0
+    # One stream-slot of load per MB committed: coarse, but keeps a
+    # KV-heavy replica from looking idle on stream count alone.
+    return n + kv / 1e6
+
+
+class Router:
+    """Stateless policy + the round-robin cursor."""
+
+    def __init__(self, policy: str = ROUTE_LEAST):
+        policy = (policy or ROUTE_LEAST).lower()
+        if policy not in (ROUTE_LEAST, ROUTE_RR):
+            raise ValueError(f"FLEET_ROUTE must be least|rr, got {policy!r}")
+        self.policy = policy
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _affinity(self, replica, feats: dict) -> int:
+        """Longest cached prefix bucket this replica holds for the
+        prompt (0 = none / no cache / non-text request)."""
+        eng = getattr(replica, "engine", None)
+        cache = getattr(eng, "prefix_cache", None)
+        if cache is None or "input_ids" not in feats:
+            return 0
+        L = int(feats.get("length", 0))
+        if L <= 1:
+            return 0
+        ids = np.asarray(feats["input_ids"], np.int32)[:L]
+        return int(cache.peek(ids, L))
+
+    def order(self, healthy: list, feats: dict) -> list:
+        """Candidate replicas, best first.  The fleet tries them in
+        order (a shed on the first falls through to the next)."""
+        if not healthy:
+            return []
+        if self.policy == ROUTE_RR:
+            with self._lock:
+                k = self._rr % len(healthy)
+                self._rr += 1
+            return healthy[k:] + healthy[:k]
+        scored = [
+            (-self._affinity(r, feats), replica_load(r), i, r)
+            for i, r in enumerate(healthy)
+        ]
+        scored.sort(key=lambda t: t[:3])
+        return [r for *_, r in scored]
+
+    def pick_adopter(self, healthy: list):
+        """Failover target for one checkpointed stream: round-robin
+        over the healthy set so a dead replica's streams SPREAD
+        instead of dog-piling one survivor."""
+        if not healthy:
+            return None
+        with self._lock:
+            k = self._rr % len(healthy)
+            self._rr += 1
+        return healthy[k]

@@ -255,6 +255,25 @@ class ServiceConfig(BaseModel):
     # Seconds the SIGTERM drain waits for in-flight work before exit.
     drain_grace_s: float = 30.0
 
+    # Replica fleet (engine/fleet.py + scheduler/router.py): run this
+    # many INDEPENDENT continuous decode loops — each with its own
+    # engine, supervisor, watchdog, KV pool and prefix cache — behind
+    # a health-gated router.  A dead replica's streams checkpoint at
+    # their delivered-token cursor and resume token-identically on a
+    # healthy replica.  1 (default) = the single-engine path, exactly.
+    fleet_replicas: int = 1
+    # Routing policy: "least" = health → least-loaded (committed KV
+    # bytes + queue depth) → prefix affinity; "rr" = health-gated
+    # round-robin (the A/B baseline).
+    fleet_route: str = "least"
+    # Consecutive dispatch faults that open a replica's circuit
+    # breaker (routing avoids it; a half-open probe re-admits).
+    fleet_breaker_n: int = 3
+    # Seconds a breaker may sit open before the replica is evicted:
+    # its streams failover to a healthy replica.  Half-open probes
+    # start at half this interval.
+    fleet_evict_s: float = 10.0
+
     # Fault tolerance (engine/faults.py + engine/supervisor.py).
     # Deterministic fault-injection schedule wrapped around the
     # device-dispatch boundaries; off (None) = zero overhead.  Grammar
@@ -275,6 +294,11 @@ class ServiceConfig(BaseModel):
     # death → checkpoint streams, rebuild device state, resume) before
     # /readyz goes permanently unready.
     engine_restarts_max: int = 3
+    # Sliding restart window in seconds: the budget above counts only
+    # restarts within the trailing window, so a long-lived engine is
+    # not condemned by faults from hours ago.  0 (default) = the
+    # historical lifetime cap.
+    engine_restart_window_s: float = 0.0
     # Supervised crash recovery for the continuous decode loop; off
     # restores the seed's error-every-stream behavior on a fault.
     supervise: bool = True
@@ -407,6 +431,37 @@ class ServiceConfig(BaseModel):
             raise ValueError("DECODE_WINDOW must be in [1, 64]")
         return v
 
+    @field_validator("fleet_replicas")
+    @classmethod
+    def _check_fleet_replicas(cls, v: int) -> int:
+        if not (1 <= v <= 64):
+            raise ValueError("FLEET_REPLICAS must be in [1, 64]")
+        return v
+
+    @field_validator("fleet_route")
+    @classmethod
+    def _check_fleet_route(cls, v: str) -> str:
+        v = v.lower()
+        if v not in ("least", "rr"):
+            raise ValueError(f"FLEET_ROUTE must be 'least' or 'rr', got {v!r}")
+        return v
+
+    @field_validator("fleet_breaker_n")
+    @classmethod
+    def _check_fleet_breaker_n(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError("FLEET_BREAKER_N must be >= 1")
+        return v
+
+    @field_validator("fleet_evict_s", "engine_restart_window_s")
+    @classmethod
+    def _check_fleet_nonneg(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError(
+                "FLEET_EVICT_S/ENGINE_RESTART_WINDOW_S must be >= 0"
+            )
+        return v
+
     @field_validator("fault_spec")
     @classmethod
     def _check_fault_spec(cls, v: str | None) -> str | None:
@@ -467,8 +522,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       PREFILL_BUDGET, PREFILL_MAX_PROMPT, DECODE_WINDOW,
       DECODE_WINDOW_AUTO, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
-      ENGINE_RESTARTS_MAX, SUPERVISE, TRACE, TRACE_RING, FLIGHT_RING,
-      PROFILE_DIR, LOG_FORMAT.
+      ENGINE_RESTARTS_MAX, ENGINE_RESTART_WINDOW_S, SUPERVISE,
+      FLEET_REPLICAS, FLEET_ROUTE, FLEET_BREAKER_N, FLEET_EVICT_S,
+      TRACE, TRACE_RING, FLIGHT_RING, PROFILE_DIR, LOG_FORMAT.
     """
     e = dict(os.environ)
     if env:
@@ -492,6 +548,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "prompt_prefix": "PROMPT_PREFIX",
         "spec_decode": "SPEC_DECODE",
         "priority_default": "PRIORITY_DEFAULT",
+        "fleet_route": "FLEET_ROUTE",
         "fault_spec": "FAULT_SPEC",
         "log_format": "LOG_FORMAT",
         "profile_dir": "PROFILE_DIR",
@@ -524,6 +581,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "fault_seed": "FAULT_SEED",
         "dispatch_retries": "DISPATCH_RETRIES",
         "engine_restarts_max": "ENGINE_RESTARTS_MAX",
+        "fleet_replicas": "FLEET_REPLICAS",
+        "fleet_breaker_n": "FLEET_BREAKER_N",
         "trace_ring": "TRACE_RING",
         "flight_ring": "FLIGHT_RING",
     }
@@ -543,6 +602,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("drain_grace_s", "DRAIN_GRACE_S"),
         ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
         ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),
+        ("fleet_evict_s", "FLEET_EVICT_S"),
+        ("engine_restart_window_s", "ENGINE_RESTART_WINDOW_S"),
     ):
         v = get(var)
         if v is not None:

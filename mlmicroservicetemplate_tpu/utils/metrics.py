@@ -93,7 +93,8 @@ SPEC_EMITTED = Histogram(
 SHED = Counter(
     "requests_shed_total",
     "Load-shed requests by reason "
-    "(queue_full | deadline | kv_budget | drain)",
+    "(queue_full | deadline | kv_budget | drain | degraded | "
+    "fleet_down)",
     ["model", "reason"],
 )
 TTFT = Histogram(
@@ -134,13 +135,15 @@ PREEMPTIONS = Counter(
 )
 KV_COMMITTED = Gauge(
     "kv_committed_bytes",
-    "KV-cache bytes currently committed against the admission budget",
-    ["model"],
+    "KV-cache bytes currently committed against the admission budget, "
+    "per fleet replica (replica 0 = the single-engine path)",
+    ["model", "replica"],
 )
 KV_POOL_BLOCKS = Gauge(
     "kv_pool_blocks",
-    "Paged-KV pool blocks by state (used includes prefix-cache pins)",
-    ["model", "state"],
+    "Paged-KV pool blocks by state (used includes prefix-cache pins), "
+    "per fleet replica",
+    ["model", "replica", "state"],
 )
 ENGINE_RESTARTS = Counter(
     "engine_restarts_total",
@@ -161,14 +164,31 @@ DISPATCH_TIMEOUTS = Counter(
 )
 STREAMS_RECOVERED = Counter(
     "streams_recovered_total",
-    "Live streams checkpointed and requeued across an engine rebuild",
-    ["model"],
+    "Live streams checkpointed and resumed token-identically, by "
+    "replica and cause (restart = same-engine rebuild, failover = "
+    "re-routed to a healthy fleet replica)",
+    ["model", "replica", "cause"],
 )
 STREAMS_LOST = Counter(
     "streams_lost_total",
-    "Live streams error-terminated by an unrecoverable engine fault "
-    "(no supervisor, or the restart budget was exhausted)",
-    ["model"],
+    "Live streams error-terminated by an unrecoverable engine fault, "
+    "by replica and cause (fault = no supervisor or budget spent, "
+    "no_replica = every fleet replica was dead at failover)",
+    ["model", "replica", "cause"],
+)
+FLEET_FAILOVERS = Counter(
+    "fleet_failovers_total",
+    "Replica evacuations: a replica died (restart budget spent, loop "
+    "death, or breaker open past FLEET_EVICT_S) and its streams were "
+    "re-routed for token-identical resume",
+    ["model", "replica", "cause"],
+)
+FLEET_BREAKER = Gauge(
+    "fleet_breaker_state",
+    "Per-replica circuit breaker state: 0=closed (healthy), "
+    "1=half-open (probing), 2=open (routing avoids it), 3=dead "
+    "(evicted; streams failed over)",
+    ["model", "replica"],
 )
 CHAIN_DEPTH = Gauge(
     "stream_chain_depth",

@@ -84,6 +84,23 @@ else
     echo "== fused-decode smoke skipped (FUSE_SMOKE=0) =="
 fi
 
+# Fleet-failover smoke: R=2 replicas, a replica-scoped fatal schedule
+# (r0:chunk:fatal@2) that exhausts replica 0's restart window mid-
+# fused-window (paged, int8, DECODE_WINDOW=4), asserting ZERO streams
+# lost — every stream completes token-identically on the survivor and
+# the dead replica's block ledger drains to zero (chaos tier, so it
+# stays out of tier-1).  FLEET_SMOKE=0 skips.
+if [ "${FLEET_SMOKE:-1}" != "0" ]; then
+    echo "== fleet-failover smoke (R=2, r0:chunk:fatal@2) =="
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        FLEET_SMOKE_SPEC="${FLEET_SMOKE_SPEC:-r0:chunk:fatal@2}" \
+        python -m pytest \
+        tests/test_fleet.py::test_fleet_failover_chaos_paged_int8_window \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== fleet-failover smoke skipped (FLEET_SMOKE=0) =="
+fi
+
 # Observability smoke: the full HTTP service under TRACE=1 with a
 # transient fault injected, then /debug/trace (schema-valid Perfetto
 # JSON with every stage span) and /debug/engine (flight recorder with
