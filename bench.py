@@ -161,6 +161,30 @@ async def bench_serving() -> "tuple[dict, object]":
             "chain_depth": getattr(cdl, "chain_depth", None) if cdl else None,
         }
 
+        # Host KV tier accounting (round 14): swap traffic across the
+        # device/host boundary, how much of the resume prefetch
+        # overlapped live decode, and host-tier prefix hits — in every
+        # BENCH json like decode_fusion (zeros/None when the tier is
+        # off or the headline model is non-generative).
+        tier = getattr(engine, "kv_host", None)
+        pf_total = getattr(cdl, "prefetch_blocks_total", 0) if cdl else 0
+        pf_live = getattr(cdl, "prefetch_blocks_live", 0) if cdl else 0
+        kv_tier = {
+            "enabled": bool(tier is not None and tier.enabled),
+            "swap_outs": getattr(cdl, "swap_outs", 0) if cdl else 0,
+            "swap_resumes": getattr(cdl, "swap_ins", 0) if cdl else 0,
+            "swap_fallbacks": getattr(cdl, "swap_fallbacks", 0) if cdl else 0,
+            "swap_out_bytes": getattr(cdl, "swap_out_bytes", 0) if cdl else 0,
+            "swap_in_bytes": getattr(cdl, "swap_in_bytes", 0) if cdl else 0,
+            "prefetch_overlap_ratio": (
+                round(pf_live / pf_total, 4) if pf_total else None
+            ),
+            "host_prefix_hits": getattr(
+                cdl, "host_prefix_promotes", 0
+            ) if cdl else 0,
+            "host_pool": tier.stats() if tier is not None else None,
+        }
+
         return {
             "p50_ms": round(statistics.median(lats) * 1000, 3),
             "p99_ms": round(
@@ -178,6 +202,7 @@ async def bench_serving() -> "tuple[dict, object]":
             "n_devices": engine.replicas.n_devices,
             "dispatch_attribution": attribution,
             "decode_fusion": decode_fusion,
+            "kv_tier": kv_tier,
         }, engine
     finally:
         await client.close()

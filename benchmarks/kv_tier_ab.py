@@ -1,0 +1,169 @@
+"""Tiered-KV A/B: host-RAM swap vs recompute under memory pressure.
+
+The judged claim (ISSUE 9): when the paged pool runs dry and streams
+checkpoint, a host tier (``KV_HOST_BUDGET_MB``) that swaps the resume
+KV out and prefetches it back beats re-prefilling it from scratch —
+fewer prefill dispatches, lower resume latency (the longest
+inter-chunk gap a checkpointed stream's client observes), and better
+goodput at the same device budget.
+
+Two arms over the same gpt2 service (random-init weights — the swap
+economics depend on shapes and schedule, not weights), both at a
+deliberately tight ``KV_BUDGET_MB`` so decode growth forces dry-pool
+checkpoints:
+
+- **recompute**: ``KV_HOST_BUDGET_MB=0`` — today's checkpoint path
+  (free the blocks, later re-prefill prompt+delivered).
+- **swap**: ``KV_HOST_BUDGET_MB=64`` — blocks copy out to host RAM and
+  prefetch back, zero re-prefill.
+
+Reported per arm: total wall, aggregate delivered tokens/s (goodput),
+TTFT p50, the p50/max of each stream's LONGEST inter-chunk gap (the
+resume-latency proxy — an uninterrupted stream's gaps are one chunk's
+compute; a checkpointed one's longest gap spans its requeue + resume),
+prefill dispatches, and the server's swap/stall counters.
+
+    python benchmarks/kv_tier_ab.py              # current backend
+    DEVICE=cpu python benchmarks/kv_tier_ab.py   # CPU sanity run
+
+One JSON line per arm to stdout, a markdown table to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+from harness import ServiceUnderTest, pctile  # noqa: E402
+
+N_STREAMS = int(os.environ.get("TIER_AB_N", "6"))
+# ~11 gpt2 KV blocks at KV_BLOCK_SIZE=16: two 64-bucket streams admit
+# (5 blocks each) but cannot BOTH grow through decode (6 each) — the
+# dry-pool checkpoint fires continuously under the queue's churn.
+BUDGET_MB = float(os.environ.get("TIER_AB_BUDGET_MB", "13"))
+HOST_MB = float(os.environ.get("TIER_AB_HOST_MB", "64"))
+PROMPT = "the quick brown fox jumps over the lazy dog and then some more"
+
+BASE_ENV = {
+    "MODEL_NAME": "gpt2",
+    "BATCH_BUCKETS": "1,4",
+    "SEQ_BUCKETS": "64",
+    # 32-token budgets make a stream's worst case 6 blocks vs its
+    # 5-block initial: two streams admit into the 11-block pool but
+    # cannot both grow — decode growth finds it dry and checkpoints.
+    "MAX_DECODE_LEN": "32",
+    "MAX_STREAMS": "4",
+    "MAX_STREAM_QUEUE": "16",
+    "PAGED_KV": "1",
+    "KV_BLOCK_SIZE": "16",
+    "KV_BUDGET_MB": str(BUDGET_MB),
+    "WARMUP": "1",
+}
+
+
+async def _counter(client, name: str) -> float:
+    """Sum a counter family's samples off one /metrics scrape."""
+    text = await (await client.get("/metrics")).text()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+async def _one_stream(client, i: int):
+    t0 = time.perf_counter()
+    resp = await client.post(
+        "/predict", json={"text": PROMPT, "stream": True}
+    )
+    assert resp.status == 200, await resp.text()
+    ttft, gaps, t_prev, steps = None, [], None, 0
+    async for line in resp.content:
+        now = time.perf_counter()
+        if ttft is None:
+            ttft = now - t0
+        if t_prev is not None:
+            gaps.append(now - t_prev)
+        t_prev = now
+        msg = json.loads(line)
+        if msg.get("done"):
+            steps = int(msg.get("decode_steps", 0))
+            break
+    return {
+        "ttft": ttft if ttft is not None else time.perf_counter() - t0,
+        "max_gap": max(gaps) if gaps else 0.0,
+        "wall": time.perf_counter() - t0,
+        "steps": steps,
+    }
+
+
+async def _arm(name: str, host_mb: float) -> dict:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    env = {**BASE_ENV, "KV_HOST_BUDGET_MB": str(host_mb), **dev}
+    async with ServiceUnderTest(env) as s:
+        t0 = time.perf_counter()
+        rows = await asyncio.gather(
+            *(_one_stream(s.client, i) for i in range(N_STREAMS))
+        )
+        wall = time.perf_counter() - t0
+        status = await (await s.client.get("/status")).json()
+        tier = status.get("kv_tier") or {}
+        prefills = (
+            status.get("decode", {})
+            .get("dispatch_counts", {})
+            .get("prefill", 0)
+        )
+        tokens = sum(r["steps"] for r in rows)
+        max_gaps = [r["max_gap"] for r in rows]
+        stalls = await _counter(s.client, "kv_growth_stalls_total")
+        return {
+            "growth_stalls": int(stalls),
+            "arm": name,
+            "streams": N_STREAMS,
+            "pool_blocks": status.get("scheduler", {}).get(
+                "kv_budget_bytes", 0
+            ),
+            "wall_s": round(wall, 2),
+            "goodput_tok_s": round(tokens / wall, 2) if wall else 0.0,
+            "ttft_p50_ms": round(
+                statistics.median([r["ttft"] for r in rows]) * 1e3, 1
+            ),
+            "resume_gap_p50_ms": round(
+                statistics.median(max_gaps) * 1e3, 1
+            ),
+            "resume_gap_max_ms": round(pctile(max_gaps, 1.0) * 1e3, 1),
+            "prefill_dispatches": prefills,
+            "swap_resumes": tier.get("swap_resumes", 0),
+            "swap_fallbacks": tier.get("swap_fallbacks", 0),
+            "swap_out_bytes": tier.get("swap_out_bytes", 0),
+            "prefetch_overlap_ratio": tier.get("prefetch_overlap_ratio"),
+        }
+
+
+async def main() -> None:
+    rows = [
+        await _arm("recompute", 0.0),
+        await _arm("swap", HOST_MB),
+    ]
+    print("\n| arm | metrics |", file=sys.stderr)
+    print("|---|---|", file=sys.stderr)
+    for row in rows:
+        metrics = ", ".join(
+            f"{k}={v}" for k, v in row.items() if k != "arm"
+        )
+        print(f"| {row['arm']} | {metrics} |", file=sys.stderr)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

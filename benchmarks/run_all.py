@@ -160,6 +160,15 @@ async def main() -> None:
             check=False,
         )
 
+    # Tiered KV (round-14 tentpole): resume latency + goodput under
+    # memory pressure, host-RAM swap vs the recompute checkpoint path.
+    # TIER_AB=0 skips.
+    if os.environ.get("TIER_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "kv_tier_ab.py")],
+            check=False,
+        )
+
     # Replica fleet (round-13 tentpole): goodput + p99 TTFT through a
     # deterministic replica kill and recovery, FLEET_REPLICAS=2 with
     # token-identical failover vs the single-replica blast radius.

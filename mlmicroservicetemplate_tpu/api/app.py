@@ -1192,6 +1192,27 @@ async def handle_status(request: web.Request) -> web.Response:
                 for site, a in engine.dispatch_attribution().items()
             },
         }
+    tier = getattr(engine, "kv_host", None)
+    if cdl is not None and tier is not None and tier.enabled:
+        # Host KV tier (KV_HOST_BUDGET_MB; docs/kv-tiering.md): swap
+        # traffic, prefetch overlap and the host pool/ledger state.
+        total = getattr(cdl, "prefetch_blocks_total", 0)
+        live = getattr(cdl, "prefetch_blocks_live", 0)
+        body["kv_tier"] = {
+            "swap_outs": getattr(cdl, "swap_outs", 0),
+            "swap_resumes": getattr(cdl, "swap_ins", 0),
+            "swap_fallbacks": getattr(cdl, "swap_fallbacks", 0),
+            "swap_out_bytes": getattr(cdl, "swap_out_bytes", 0),
+            "swap_in_bytes": getattr(cdl, "swap_in_bytes", 0),
+            "prefetch_overlap_ratio": (
+                round(live / total, 4) if total else None
+            ),
+            "host_prefix_promotes": getattr(
+                cdl, "host_prefix_promotes", 0
+            ),
+            "prefetch_blocks": getattr(cdl, "swap_chunk_blocks", 0),
+            "host_pool": tier.stats(),
+        }
     if cdl is not None and getattr(cdl, "prefill_chunk", 0):
         body["prefill"] = {
             "chunk": cdl.prefill_chunk,
@@ -1274,6 +1295,7 @@ async def handle_engine_debug(request: web.Request) -> web.Response:
             "active": len(cdl.active),
             "queued": cdl.queue.qsize(),
             "prefilling": len(cdl._prefilling),
+            "swapping": len(getattr(cdl, "_swapping", ())),
             "chunk_dispatches": cdl.chunk_dispatches,
             "prefill_dispatches": cdl.prefill_dispatches,
             "preemptions": cdl.preemptions,

@@ -287,6 +287,32 @@ class InferenceEngine:
                     num = max(1, int(getattr(cfg, "max_streams", 8))) * worst
                 self.kv_pool = BlockPool(num, bb)
 
+            # Host-RAM KV tier (KV_HOST_BUDGET_MB; docs/kv-tiering.md):
+            # checkpointed streams swap their blocks out to pinned host
+            # buffers instead of recomputing, and evicted prefix-cache
+            # entries demote there instead of dying.  The tier object
+            # survives reset_device_state (host RAM outlives a device
+            # rebuild — that is the point) and, in a fleet, is shared
+            # by every replica (engine/fleet.py re-points it).
+            self.kv_host = None
+            host_mb = float(getattr(cfg, "kv_host_budget_mb", 0.0) or 0.0)
+            if host_mb > 0:
+                if not getattr(cfg, "paged_kv", False):
+                    raise ValueError(
+                        "KV_HOST_BUDGET_MB requires PAGED_KV=1 (the host "
+                        "tier swaps paged blocks; the contiguous layout "
+                        "has no block identity to swap)"
+                    )
+                if self.paged_kv:
+                    from .kv_blocks import KVHostTier
+
+                    self.kv_host = KVHostTier(host_mb, self.kv_block_bytes())
+            # Prefix demotions queued by on_evict for the decode loop to
+            # gather at its next chunk boundary (the eviction itself
+            # must not dispatch: it can run under the cache lock).
+            self._host_demote_pending: list = []
+            self._host_demote_on = True
+
             # Chunked prefill (PREFILL_CHUNK>0, decoder families;
             # docs/chunked-prefill.md): the continuous loop splits
             # prompts into PREFILL_CHUNK-token windows interleaved
@@ -340,11 +366,23 @@ class InferenceEngine:
                 # eviction must release the cache's pool ref.
                 on_evict = None
                 if self.paged_kv:
-                    def on_evict(entry):
+                    def on_evict(entry, key=None):
                         from .kv_blocks import PagedPrefix
 
-                        if isinstance(entry, PagedPrefix):
-                            self.kv_pool.free(list(entry.block_ids))
+                        if not isinstance(entry, PagedPrefix):
+                            return
+                        # Host tier on: hand the pin to the decode loop
+                        # for demotion (the block refs transfer with it
+                        # — freed only after the device→host copy) so
+                        # the prefix outlives device-budget pressure.
+                        if (
+                            self.kv_host is not None
+                            and key is not None
+                            and self._host_demote_on
+                        ):
+                            self._host_demote_pending.append((key, entry))
+                            return
+                        self.kv_pool.free(list(entry.block_ids))
 
                 self.prefix_cache = PrefixCache(
                     self.seq_buckets,
@@ -422,6 +460,9 @@ class InferenceEngine:
             self.paged_kv = False
             self.kv_block_size = int(getattr(cfg, "kv_block_size", 16))
             self.kv_pool = None
+            self.kv_host = None
+            self._host_demote_pending = []
+            self._host_demote_on = True
             self.prefill_chunk = 0
         # Decode steps actually executed by the most recent non-streaming
         # seq2seq dispatch (early-exit observability; also in /metrics).
@@ -724,10 +765,19 @@ class InferenceEngine:
         slot state and re-pointing at the fresh pool."""
         # Flush BEFORE swapping the pool: paged pins free through
         # on_evict into whatever ``kv_pool`` currently points at, and
-        # those block ids belong to the OLD pool.
-        if self.prefix_cache is not None:
-            while self.prefix_cache.pop_lru() is not None:
-                pass
+        # those block ids belong to the OLD pool.  Demotion is
+        # suspended — these pins name buffers of the state being torn
+        # down, and any demotions still pending reference the OLD pool
+        # too, so both free/die with it.  (Host-tier entries already
+        # MATERIALIZED survive: host RAM outlives the rebuild.)
+        self._host_demote_on = False
+        try:
+            if self.prefix_cache is not None:
+                while self.prefix_cache.pop_lru() is not None:
+                    pass
+        finally:
+            self._host_demote_on = True
+        self._host_demote_pending = []
         if self.paged_kv and self.kv_pool is not None:
             from .kv_blocks import BlockPool
 

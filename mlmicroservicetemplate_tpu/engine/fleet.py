@@ -185,6 +185,7 @@ class Replica:
             "active": len(cdl.active),
             "queued": cdl.queue.qsize(),
             "prefilling": len(cdl._prefilling),
+            "swapping": len(getattr(cdl, "_swapping", ())),
             "kv_committed_bytes": self.admission.committed_bytes,
         }
 
@@ -259,6 +260,15 @@ class ReplicaFleet:
             cdl.on_fault = self._on_fault_cb(rep)
             cdl.on_ok = breaker.record_ok
             self.replicas.append(rep)
+        # ONE host KV tier for the whole fleet (KV_HOST_BUDGET_MB;
+        # docs/kv-tiering.md): host copies are replica-agnostic (same
+        # params produce the same KV), so a failed-over stream
+        # swap-resumes on its adopter and a demoted prefix serves every
+        # replica — the fleet-scale host-backed cache.
+        shared_tier = getattr(self.replicas[0].engine, "kv_host", None)
+        if shared_tier is not None:
+            for rep in self.replicas[1:]:
+                rep.engine.kv_host = shared_tier
         self._refresh_gauges()
         log.info(
             "replica fleet up: %d replicas, route=%s, breaker_n=%d, "
@@ -368,6 +378,28 @@ class ReplicaFleet:
                 # submit (its loop refuses new streams); same answer.
                 last_err = e
         raise last_err
+
+    def pick_batch_replica(self, feats: dict):
+        """Route one unary ``/predict`` batch dispatch: the same health
+        gate + router ordering streams get (ROADMAP item 3 leftover —
+        the batch path used to run on the base engine, bypassing
+        health gating and least-loaded placement).  Returns a healthy
+        ``Replica``; raises ``QueueFullError(fleet_down)`` when none
+        remain.  The caller reports the dispatch outcome through
+        ``rep.breaker`` so batch faults open the breaker too."""
+        from ..scheduler.policy import QueueFullError
+
+        self.sweep()
+        healthy = self.healthy_replicas()
+        if not healthy:
+            self._shed("fleet_down")
+            raise QueueFullError(
+                "every fleet replica is dead",
+                reason="fleet_down", retry_after_s=self.retry_after_s(),
+            )
+        for rep in self.router.order(healthy, feats):
+            return rep
+        return healthy[0]
 
     # -- failover ------------------------------------------------------
 

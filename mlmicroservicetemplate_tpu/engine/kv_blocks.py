@@ -195,6 +195,241 @@ class StreamBlocks:
             self.shared = 0
 
 
+class HostBlockPool(BlockPool):
+    """Host-RAM block tier (KV_HOST_BUDGET_MB): the device pool's
+    free-list/refcount discipline PLUS the storage itself — one
+    preallocated numpy buffer per pool leaf, mirroring the device
+    pool's per-layer layout ([num_blocks, block_size, heads, dim]
+    payloads, plus scale leaves under QUANT_KV=int8), so a block's
+    content round-trips device↔host by id with no reshaping.
+
+    Swapped-out streams and demoted prefix-cache entries live here
+    instead of being recomputed: copying KV back over PCIe/ICI is the
+    ChunkFlow trade — bandwidth is cheaper than re-prefill compute
+    (arXiv 2605.11335).  Buffers are plain numpy: "pinned" in the
+    practical sense that they are allocated once up front and written
+    in place, never reallocated per swap."""
+
+    def __init__(self, num_blocks: int, block_bytes: int, leaf_specs):
+        import numpy as np
+
+        super().__init__(num_blocks, block_bytes)
+        # leaf_specs: [(per-block shape, dtype)] in jax.tree.leaves
+        # order over (cache_k, cache_v) — the canonical order the
+        # loop's gather/scatter executables flatten to.
+        self.leaves = [
+            np.zeros((self.num_blocks,) + tuple(shape), dtype)
+            for shape, dtype in leaf_specs
+        ]
+
+    def write(self, ids: list[int], leaf_vals) -> None:
+        """Store block rows: ``leaf_vals[i]`` is [len(ids), bs, ...]."""
+        import numpy as np
+
+        idx = np.asarray(ids, np.int64)
+        for buf, vals in zip(self.leaves, leaf_vals):
+            buf[idx] = vals
+
+    def read(self, ids: list[int]):
+        """Fetch block rows, one [len(ids), bs, ...] array per leaf."""
+        import numpy as np
+
+        idx = np.asarray(ids, np.int64)
+        return [buf[idx] for buf in self.leaves]
+
+
+class SwapEntry:
+    """One swapped-out unit in the host tier: the host block ids
+    holding a stream's resume-prompt KV (kind ``stream``) or a demoted
+    prefix pin's KV (kind ``prefix``), plus the token count they
+    cover.  ``alive`` flips False at eviction — a waiting stream whose
+    entry died falls back to recompute; ``ready`` flips True once the
+    async device→host copy has materialized into the buffers."""
+
+    __slots__ = (
+        "ids", "tokens", "kind", "key", "alive", "ready", "pool", "ledger",
+    )
+
+    def __init__(self, ids: list[int], tokens: int, kind: str, key=None,
+                 pool=None, ledger=None):
+        self.ids = list(ids)
+        self.tokens = int(tokens)
+        self.kind = kind
+        self.key = key
+        self.alive = True
+        self.ready = False
+        # Backrefs: which tier holds these ids (an adopting loop checks
+        # the pool identity — a non-shared tier's entry is unusable)
+        # and which ledger frees them (release routes through it, so a
+        # foreign entry can never free into the wrong pool).
+        self.pool = pool
+        self.ledger = ledger
+
+
+class SwapLedger:
+    """The cross-tier map: which host blocks hold which stream/prefix
+    KV.  Conservation invariant (pinned by test): every host-pool
+    block is owned by exactly ONE alive entry at refcount 1, so
+    releasing every entry drains the host pool to zero and a double
+    release is absorbed exactly once (the underlying pool still raises
+    on a true double free).  LRU eviction prefers demoted prefix
+    entries over stream swaps — a waiting stream's resume is hotter
+    than a cache entry's maybe-reuse."""
+
+    def __init__(self, pool: HostBlockPool):
+        from collections import OrderedDict
+
+        self.pool = pool
+        self._lru: "OrderedDict[SwapEntry, None]" = OrderedDict()
+        self._prefix: dict = {}
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def reserve(self, n_blocks: int, tokens: int, kind: str,
+                key=None) -> SwapEntry | None:
+        """Allocate ``n_blocks`` host blocks as a new entry, LRU-
+        evicting older entries (prefix first) to make room; None when
+        the tier cannot hold it even empty."""
+        if n_blocks <= 0 or n_blocks > self.pool.num_blocks:
+            return None
+        with self._lock:
+            while True:
+                try:
+                    ids = self.pool.alloc(n_blocks)
+                    break
+                except OutOfBlocks:
+                    if not self._evict_one_locked():
+                        return None
+            entry = SwapEntry(
+                ids, tokens, kind, key=key, pool=self.pool, ledger=self,
+            )
+            self._lru[entry] = None
+            if kind == "prefix" and key is not None:
+                self._prefix[key] = entry
+            return entry
+
+    def _evict_one_locked(self) -> bool:
+        victim = None
+        for e in self._lru:  # oldest-first; prefer prefix entries
+            if e.kind == "prefix":
+                victim = e
+                break
+            if victim is None:
+                victim = e
+        if victim is None:
+            return False
+        self._release_locked(victim)
+        self.evictions += 1
+        return True
+
+    def _release_locked(self, entry: SwapEntry) -> None:
+        if not entry.alive:
+            return
+        entry.alive = False
+        self._lru.pop(entry, None)
+        if entry.key is not None:
+            self._prefix.pop(entry.key, None)
+        self.pool.free(entry.ids)
+
+    def release(self, entry: SwapEntry) -> None:
+        with self._lock:
+            self._release_locked(entry)
+
+    def touch(self, entry: SwapEntry) -> None:
+        with self._lock:
+            if entry.alive:
+                self._lru.move_to_end(entry)
+
+    def prefix_get(self, key) -> SwapEntry | None:
+        """Host-tier prefix lookup by (bucket, content-hash) key;
+        touches LRU recency on hit."""
+        with self._lock:
+            e = self._prefix.get(key)
+            if e is not None and e.alive:
+                self._lru.move_to_end(e)
+                return e
+            return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            streams = sum(1 for e in self._lru if e.kind == "stream")
+            return {
+                "entries": len(self._lru),
+                "stream_entries": streams,
+                "prefix_entries": len(self._lru) - streams,
+                "evictions": self.evictions,
+                "used_blocks": self.pool.used_blocks,
+                "free_blocks": self.pool.free_blocks,
+            }
+
+
+class KVHostTier:
+    """Holder for one host-RAM KV tier: budget + lazily-built pool and
+    ledger (leaf shapes are only known once the paged device pools are
+    built).  Shared by every fleet replica of one process — the host
+    copies are replica-agnostic (same params produce the same KV), so
+    a failed-over stream can swap-resume on its adopter and a demoted
+    prefix serves the whole fleet."""
+
+    def __init__(self, budget_mb: float, block_bytes: int):
+        self.budget_bytes = int(float(budget_mb) * 1e6)
+        self.block_bytes = int(block_bytes)
+        self.num_blocks = self.budget_bytes // max(1, self.block_bytes)
+        self.pool: HostBlockPool | None = None
+        self.ledger: SwapLedger | None = None
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_blocks > 0
+
+    def ensure_pool(self, leaf_specs) -> bool:
+        """Build the buffers on first use; False when the budget holds
+        no whole block (tier effectively off)."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if self.pool is None:
+                self.pool = HostBlockPool(
+                    self.num_blocks, self.block_bytes, leaf_specs
+                )
+                self.ledger = SwapLedger(self.pool)
+        return True
+
+    def reserve(self, n_blocks: int, tokens: int, kind: str,
+                key=None) -> SwapEntry | None:
+        return (
+            self.ledger.reserve(n_blocks, tokens, kind, key=key)
+            if self.ledger is not None else None
+        )
+
+    def release(self, entry: SwapEntry) -> None:
+        if self.ledger is not None:
+            self.ledger.release(entry)
+
+    def prefix_get(self, key) -> SwapEntry | None:
+        return (
+            self.ledger.prefix_get(key) if self.ledger is not None else None
+        )
+
+    def prefix_resident(self, key) -> bool:
+        return self.prefix_get(key) is not None
+
+    def stats(self) -> dict:
+        base = {
+            "budget_bytes": self.budget_bytes,
+            "block_bytes": self.block_bytes,
+            "num_blocks": self.num_blocks,
+        }
+        if self.ledger is not None:
+            base.update(self.ledger.stats())
+        return base
+
+
 @dataclass(frozen=True)
 class PagedPrefix:
     """A prefix-cache entry in paged mode: no KV copy, just the

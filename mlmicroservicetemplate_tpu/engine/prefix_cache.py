@@ -73,8 +73,31 @@ class PrefixCache:
         # Paged mode: entries are kv_blocks.PagedPrefix block-ref pins,
         # not KV copies; eviction must DROP the pin (pool refcount),
         # which this callback does.  Refcounting keeps eviction safe
-        # for in-flight sharers — they hold their own refs.
+        # for in-flight sharers — they hold their own refs.  The
+        # callback receives ``(entry, key)`` — the key lets a host
+        # tier (KV_HOST_BUDGET_MB) demote the evicted entry and still
+        # find it again on a later match.
         self.on_evict = on_evict
+        # Arity detected ONCE: a TypeError raised inside the callback
+        # itself must never trigger a second (double-freeing) call.
+        self._evict_two_arg = False
+        if on_evict is not None:
+            import inspect
+
+            try:
+                self._evict_two_arg = (
+                    len(inspect.signature(on_evict).parameters) >= 2
+                )
+            except (TypeError, ValueError):
+                self._evict_two_arg = False
+
+    def _evict_cb(self, entry: Any, key) -> None:
+        if self.on_evict is None:
+            return
+        if self._evict_two_arg:
+            self.on_evict(entry, key)
+        else:  # legacy single-arg callback (tests, duck-typed engines)
+            self.on_evict(entry)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -118,6 +141,22 @@ class PrefixCache:
                     return p
             return 0
 
+    def host_lookup(self, ids: np.ndarray, length: int, tier,
+                    usable=None):
+        """Longest HOST-TIER prefix of ``ids[:length]`` — consulted
+        after the device entries miss, so an entry demoted under
+        device-budget pressure (KV_HOST_BUDGET_MB) still matches and
+        can be promoted back.  Returns (P, SwapEntry) or None; the
+        caller owns the device-side promotion (block alloc + host→
+        device copy + re-insert) — this cache cannot dispatch."""
+        for p in reversed(self.buckets):
+            if p > length - 1 or (usable is not None and not usable(p)):
+                continue
+            e = tier.prefix_get((p, _key(ids, p)))
+            if e is not None:
+                return p, e
+        return None
+
     def bucket_for_insert(self, length: int) -> int | None:
         """Largest bucket ≤ length-1 (the most reusable prefix a prompt
         of this length can donate), or None when it's too short."""
@@ -152,10 +191,9 @@ class PrefixCache:
             self._entries[key] = kv
             self._bytes += self._entry_bytes(kv)
             while self._bytes > self.budget_bytes and len(self._entries) > 1:
-                _, old = self._entries.popitem(last=False)
+                okey, old = self._entries.popitem(last=False)
                 self._bytes -= self._entry_bytes(old)
-                if self.on_evict is not None:
-                    self.on_evict(old)
+                self._evict_cb(old, okey)
 
     def pop_lru(self) -> Any | None:
         """Evict the least-recently-used entry unconditionally (the
@@ -165,10 +203,9 @@ class PrefixCache:
         with self._lock:
             if not self._entries:
                 return None
-            _, old = self._entries.popitem(last=False)
+            okey, old = self._entries.popitem(last=False)
             self._bytes -= self._entry_bytes(old)
-            if self.on_evict is not None:
-                self.on_evict(old)
+            self._evict_cb(old, okey)
             return old
 
     def stats(self) -> dict:

@@ -95,7 +95,7 @@ class _Stream:
         "feats", "chunks", "loop", "cancelled", "produced", "released",
         "budget", "klass", "deadline", "started", "kv", "kv_held",
         "skip", "tokens", "preempted", "t_in", "_removed",
-        "blocks", "s_base", "s_lo", "shared_ids",
+        "blocks", "s_base", "s_lo", "shared_ids", "swap",
         "rid", "t_queued", "t_emit",
     )
 
@@ -136,6 +136,11 @@ class _Stream:
         self.s_base = 0
         self.s_lo = 0
         self.shared_ids: list[int] = []
+        # Host KV tier (engine/kv_blocks.py KVHostTier): the SwapEntry
+        # holding this stream's checkpointed KV host-side, set at
+        # swap-out; a resume with a live entry prefetches it back
+        # instead of re-prefilling (docs/kv-tiering.md).
+        self.swap = None
         # Observability: the request id (span/log correlation key —
         # the API stamps it on the feats dict), when this stream was
         # last (re-)queued (queue-wait span start) and when its last
@@ -177,6 +182,34 @@ class _PrefillJob:
         self.sb = None  # paged: StreamBlocks being grown
         self.table_row = None  # paged: np table row (sentinel-padded)
         self.ready = False
+        self.t_in = time.monotonic()
+
+
+class _SwapInJob:
+    """One checkpointed stream mid-swap-resume: its freshly allocated
+    device blocks being filled back from the host tier, a bounded
+    number per loop iteration (``_advance_swapins``).  Once every
+    block is copied, the stream goes live through the chunked-prefill
+    handoff (the restored KV is exactly what a fresh prefill of the
+    resume prompt would have written, so the handoff contract is
+    identical).  Duck-type-compatible with ``_PrefillJob`` where the
+    handoff/failure helpers are shared."""
+
+    __slots__ = (
+        "st", "ids", "L", "p_len", "sb", "table_row", "copied",
+        "ready", "state", "t_in",
+    )
+
+    def __init__(self, st: _Stream, ids: np.ndarray, L: int):
+        self.st = st
+        self.ids = ids
+        self.L = L
+        self.p_len = 0  # no CoW adoption: swap blocks are private
+        self.sb = None  # StreamBlocks being filled
+        self.table_row = None
+        self.copied = 0  # device blocks already restored
+        self.ready = False
+        self.state = None  # _drop_job_resources compatibility
         self.t_in = time.monotonic()
 
 
@@ -317,6 +350,18 @@ class ContinuousDecodeLoop:
             self._paged_insert = None
             self._gather_prefix_fns: dict[int, Any] = {}
             self._dispatched_steps: dict[int, int] = {}
+            if not self.prefill_chunk:
+                self._paged_handoff = None  # swap-resume handoff seam
+            # Host-RAM KV tier (KV_HOST_BUDGET_MB; docs/kv-tiering.md):
+            # checkpointed streams gather the blocks behind their
+            # resume prompt device→host instead of freeing-and-
+            # recomputing, and resume by prefetching them back —
+            # KV_PREFETCH_BLOCKS per iteration while decode is live,
+            # unbounded on idle — through the same interleave seam as
+            # chunked prefill.  The tier object lives on the ENGINE
+            # (it survives reset_device_state; a fleet shares one).
+            self._swap_gather_jit = None
+            self._swap_scatter_jit = None
         # Fused decode windows (DECODE_WINDOW; docs/decode-fusion.md):
         # up to W chunk scans fuse into ONE dispatch (lax.while_loop
         # with on-device EOS early exit, models/window.py), so the
@@ -328,6 +373,23 @@ class ContinuousDecodeLoop:
         # are live or waiting (their TBT and the admission/preemption
         # cadence bind at chunk boundaries).  1 = off, exactly the
         # seed's per-chunk dispatch path.
+        # Swap-resume jobs + swap-out copies pending materialization
+        # (exist in contiguous mode too so the shared loop code never
+        # branches on their presence; only paged loops populate them).
+        self._swapping: list[_SwapInJob] = []
+        self._swap_pending: list = []
+        self._swap_hold = False  # device suspect (watchdog cut)
+        self.swap_chunk_blocks = max(
+            1, int(getattr(cfg, "kv_prefetch_blocks", 4) or 4)
+        )
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self.swap_fallbacks = 0
+        self.swap_out_bytes = 0
+        self.swap_in_bytes = 0
+        self.prefetch_blocks_total = 0
+        self.prefetch_blocks_live = 0
+        self.host_prefix_promotes = 0
         self.decode_window = max(1, int(getattr(cfg, "decode_window", 1) or 1))
         if self.decode_window > 1:
             if self.spec:
@@ -579,6 +641,7 @@ class ContinuousDecodeLoop:
         a stream that never reached the loop thread)."""
         if not st.released:
             st.released = True
+            self._drop_swap(st)  # terminal: host copy has no reader left
             if self.admission is not None:
                 self.admission.release(st)
             dt = time.monotonic() - st.t_in
@@ -687,6 +750,10 @@ class ContinuousDecodeLoop:
             self._drop_job_resources(job)
             self._finish(job.st, exc)
         self._prefilling = []
+        for job in self._swapping:
+            self._drop_job_resources(job)
+            self._finish(job.st, exc)
+        self._swapping = []
         for st in self.queue.drain_all():
             self._finish(st, exc)
         for slot in list(self.active):
@@ -722,6 +789,13 @@ class ContinuousDecodeLoop:
                 # Stale waiters shed as fast 504s BEFORE any admission
                 # work — never prefill a request nobody is waiting for.
                 self._expire_queued()
+                # Host KV tier drains, at the chunk boundary: pending
+                # swap-out copies materialize into the host buffers
+                # (the async device→host transfers started at gather
+                # time have usually landed), and evicted prefix pins
+                # queued for demotion gather out.
+                self._drain_swapouts()
+                self._drain_demotions()
                 # Already-landed in-flight results route NOW (paged):
                 # EOS'd rows' blocks return to the pool before this
                 # iteration's growth pass instead of after it, and the
@@ -731,6 +805,7 @@ class ContinuousDecodeLoop:
                     not self.active
                     and not self._inflight_chunks
                     and not self._prefilling
+                    and not self._swapping
                     and self.queue.qsize() == 0
                 ):
                     st = self.queue.pop(timeout=0.05, fits=self._fits)
@@ -761,6 +836,7 @@ class ContinuousDecodeLoop:
                 # strand them slot-less.
                 while (
                     len(wave) + len(self.active) + len(self._prefilling)
+                    + len(self._swapping)
                     < self.n_slots
                 ):
                     st = self.queue.pop_nowait(fits=self._fits)
@@ -833,7 +909,8 @@ class ContinuousDecodeLoop:
                 # the wave admission: live streams' next chunk is
                 # already queued on the device, so a window here delays
                 # decode cadence by at most its own compute.
-                advanced = self._advance_prefill()
+                advanced = self._advance_swapins()
+                advanced = self._advance_prefill() or advanced
                 if len(self._inflight_chunks) > self.chain_depth:
                     self._deliver_oldest()
                 elif self._inflight_chunks and not dispatched:
@@ -881,6 +958,11 @@ class ContinuousDecodeLoop:
                     self._finish(job.st, e)
                     n_lost += 1
                 self._prefilling = []
+                for job in self._swapping:
+                    self._drop_job_resources(job)
+                    self._finish(job.st, e)
+                    n_lost += 1
+                self._swapping = []
                 for slot in list(self.active):
                     st = self.active.get(slot)
                     if st is not None:
@@ -904,10 +986,23 @@ class ContinuousDecodeLoop:
                 if self.supervisor is not None and self.supervisor.failed:
                     self._stop.set()
         # Shutdown: end every remaining consumer cleanly.
+        self._drain_swapouts()  # free demotion refs; ledger stays exact
+        if self.paged:
+            # Demotions still queued on the engine never gather now:
+            # return their device refs so the pool ledger drains.
+            pending = getattr(self.engine, "_host_demote_pending", [])
+            if pending:
+                self.engine._host_demote_pending = []
+                for _k, pp in pending:
+                    self.pool.free(list(pp.block_ids))
         for job in self._prefilling:
             self._drop_job_resources(job)
             self._finish(job.st, StreamClosedError("server stopping"))
         self._prefilling = []
+        for job in self._swapping:
+            self._drop_job_resources(job)
+            self._finish(job.st, StreamClosedError("server stopping"))
+        self._swapping = []
         for st in self.queue.drain_all():
             self._finish(st, StreamClosedError("server stopping"))
         for slot in list(self.active):
@@ -922,13 +1017,17 @@ class ContinuousDecodeLoop:
         fl = self._flight
         if fl is None or not fl.size:
             return
-        if not (self.active or self._prefilling or self._inflight_chunks):
+        if not (
+            self.active or self._prefilling or self._swapping
+            or self._inflight_chunks
+        ):
             return
         rec = dict(
             active=len(self.active),
             free_slots=len(self.free),
             queued=self.queue.qsize(),
             prefilling=len(self._prefilling),
+            swapping=len(self._swapping),
             inflight_chunks=len(self._inflight_chunks),
             chunk_dispatches=self.chunk_dispatches,
             prefill_dispatches=self.prefill_dispatches,
@@ -1027,6 +1126,14 @@ class ContinuousDecodeLoop:
             "decode loop fault (%s: %s); supervised engine restart %d/%d",
             type(exc).__name__, exc, sup.restarts, sup.max_restarts,
         )
+        # Host KV tier: the pre-fault pools are still addressable (the
+        # failed dispatch never assigned into self._state), so active
+        # streams' resume KV can swap out during the checkpoint below
+        # — UNLESS the fault was a watchdog cut, where the device may
+        # be wedged and a gather could hang too.
+        from .faults import DispatchTimeoutError
+
+        self._swap_hold = isinstance(exc, DispatchTimeoutError)
         recovered = 0
         for st, *_ in self._pending_admissions:
             recovered += self._checkpoint_requeue(st)
@@ -1043,17 +1150,35 @@ class ContinuousDecodeLoop:
             job.state = None
             recovered += self._checkpoint_requeue(job.st)
         self._prefilling = []
+        for job in self._swapping:
+            # Mid-prefetch resume: drop the half-filled device blocks
+            # (old pool) and requeue; the HOST copy survives the
+            # rebuild, so the retry still swap-resumes.
+            if job.sb is not None:
+                job.sb.release()
+                job.sb = None
+            recovered += self._checkpoint_requeue(job.st)
+        self._swapping = []
         for slot in list(self.active):
             st = self.active.pop(slot)
+            # _checkpoint_for_resume swaps the resume KV to the host
+            # tier (gather against the pre-fault pools) and derefs the
+            # blocks into the OLD pool (discarded below).
+            recovered += self._checkpoint_requeue(st)
             if self.paged and st.blocks is not None:
-                # Deref into the OLD pool (discarded below) so the
-                # StreamBlocks object can't double-free later.
+                # Finished/cancelled streams skip the checkpoint path:
+                # plain deref into the old pool.
                 st.blocks.release()
                 st.blocks = None
-            recovered += self._checkpoint_requeue(st)
+        self._swap_hold = False
         self.sampled_slots.clear()
         self.free = list(range(self.n_slots))
         self._inflight_chunks.clear()
+        # Materialize the swap-outs gathered above BEFORE the rebuild
+        # discards the old pools (the gathered copies are their own
+        # buffers, but the host write must happen while this thread
+        # still owns them — nothing else drains during recovery).
+        self._drain_swapouts()
         self._state = None
         # Device-side rebuild: fresh KV pool, params re-placed, prefix
         # cache flushed (compiled executables survive — the process is
@@ -1097,8 +1222,25 @@ class ContinuousDecodeLoop:
         is ordinary re-admission: re-estimate the KV footprint against
         THIS replica's pool, count it against this loop's admission,
         queue it.  Called from the dead replica's loop thread."""
+        entry = getattr(st, "swap", None)
+        if entry is not None:
+            tier = self._host_tier()
+            if (
+                tier is None or tier.pool is None
+                or entry.pool is not tier.pool or not entry.alive
+            ):
+                # The checkpoint's host copy lives in a tier this loop
+                # cannot read (non-shared deployment) or died: fall
+                # back to the recast/replay recompute resume.
+                self._drop_swap(st)
+                self.swap_fallbacks += 1
+                metrics.KV_SWAP_RESUMES.labels(
+                    self.engine.bundle.name, "fallback"
+                ).inc()
         if self.admission is not None:
-            st.kv = self.admission.kv_bytes_for_resume(st.feats)
+            st.kv = self.admission.kv_bytes_for_resume(
+                st.feats, swap_tokens=self._swap_tokens(st)
+            )
         try:
             st.loop.call_soon_threadsafe(self._inc_admitted)
         except RuntimeError:
@@ -1156,12 +1298,22 @@ class ContinuousDecodeLoop:
             self._drop_job_resources(job)
             h(job.st)
         self._prefilling = []
+        for job in self._swapping:
+            # Mid-prefetch resume: return the device blocks; the host
+            # entry rides the checkpoint to the adopter (usable when
+            # the fleet shares one tier, dropped otherwise).
+            self._drop_job_resources(job)
+            h(job.st)
+        self._swapping = []
         for st in self.queue.drain_all():
             h(st)
         for slot in list(self.active):
             st = self.active.pop(slot)
-            self._release_blocks(slot, st)
+            # Checkpoint FIRST: _checkpoint_for_resume swaps the
+            # resume KV out to the (possibly fleet-shared) host tier
+            # while the blocks still exist, then real-frees them.
             h(st)
+            self._release_blocks(slot, st)
         self.sampled_slots.clear()
         self.free = list(range(self.n_slots))
         self._inflight_chunks.clear()
@@ -1170,9 +1322,28 @@ class ContinuousDecodeLoop:
         # serve from them again, and they are the last refs keeping
         # pool blocks from draining to zero.
         eng = self.engine
+        if self.paged:
+            # Queued-but-ungathered demotions can never copy now:
+            # return their device refs so the corpse's ledger drains.
+            pending = getattr(eng, "_host_demote_pending", [])
+            if pending:
+                eng._host_demote_pending = []
+                for _k, pp in pending:
+                    self.pool.free(list(pp.block_ids))
         if self.paged and eng.prefix_cache is not None:
-            while eng.prefix_cache.pop_lru() is not None:
-                pass
+            # Demotion suspended: self._state is already dropped here,
+            # so the pins' content is unreachable — plain frees.
+            prev = getattr(eng, "_host_demote_on", True)
+            eng._host_demote_on = False
+            try:
+                while eng.prefix_cache.pop_lru() is not None:
+                    pass
+            finally:
+                eng._host_demote_on = prev
+        # Materialize the harvested checkpoints' swap-outs NOW: the
+        # adopter reads the host buffers, and this loop never drains
+        # again.
+        self._drain_swapouts()
         if self._flight is not None:
             self._flight.event(
                 "failover", cause=cause, streams=len(harvested),
@@ -1232,10 +1403,13 @@ class ContinuousDecodeLoop:
             self.active.pop(slot)
             self.sampled_slots.discard(slot)
             self.free.append(slot)
-            self._release_blocks(slot, st)
             if self.admission is not None:
                 self.admission.release(st)
+            # Checkpoint BEFORE the block release: the host KV tier
+            # copies the resume prompt's blocks out inside
+            # _checkpoint_for_resume while they still exist.
             self._requeue_preempted(st)
+            self._release_blocks(slot, st)
             self.preemptions += 1
             metrics.PREEMPTIONS.labels(self.engine.bundle.name).inc()
             if self._flight is not None:
@@ -1295,6 +1469,16 @@ class ContinuousDecodeLoop:
         else:
             st.skip = len(st.tokens)
         st.produced = 0
+        # Host KV tier (docs/kv-tiering.md): the feats above now spell
+        # the RESUME prompt — prompt+delivered for the recast fold,
+        # the original prompt for replay — and its KV occupies the
+        # contiguous positions [0, length) of this stream's blocks.
+        # Copy those blocks device→host BEFORE they free, so the
+        # resume prefetches them back instead of re-prefilling; then
+        # release (idempotent with any caller-side release).
+        if self.paged and st.blocks is not None:
+            self._swap_out(st)
+            st.blocks.release()
         # A checkpointed stream holds NO ledger commitment while it
         # waits (its reservation was released above by the caller).
         st.blocks = None
@@ -1312,9 +1496,13 @@ class ContinuousDecodeLoop:
         # Refresh the footprint the stream re-reserves at dequeue —
         # the recast path just FOLDED delivered tokens into the
         # prompt, so the stale admission-time estimate can undershoot
-        # the new prompt bucket.
+        # the new prompt bucket.  A host-swapped checkpoint is charged
+        # its TRUE resume cost: the prefetch blocks, not the
+        # first-window re-prefill it will never run.
         if self.admission is not None:
-            st.kv = self.admission.kv_bytes_for_resume(st.feats)
+            st.kv = self.admission.kv_bytes_for_resume(
+                st.feats, swap_tokens=self._swap_tokens(st)
+            )
         if self._flight is not None:
             self._flight.event(
                 "checkpoint_requeue", rid=st.rid, klass=st.klass,
@@ -1378,6 +1566,13 @@ class ContinuousDecodeLoop:
                     f"({self.max_prompt}) cannot join the shared batch"
                 ))
                 continue
+            if self.paged and getattr(st, "swap", None) is not None:
+                # Host-swapped checkpoint: resume by prefetching the
+                # host copy back block-by-block (zero re-prefill).
+                # False = the copy died — fall through to the normal
+                # recast/replay admission below.
+                if self._start_swapin(st):
+                    continue
             ok.append(st)
         if self.prefill_chunk:
             # Chunked routing: prompts longer than one window (or past
@@ -1493,6 +1688,12 @@ class ContinuousDecodeLoop:
             m = eng.prefix_cache.match(
                 row_ids, L, usable=eng._prefix_guard(L)
             )
+            if m is None and self.paged:
+                # Host tier: a prefix demoted under device-budget
+                # pressure promotes back on match (lock already held).
+                m = self._promote_host_prefix(
+                    row_ids, L, eng._prefix_guard(L)
+                )
             if m is None:
                 misses.append((st, row_ids, L))
                 continue
@@ -1846,6 +2047,13 @@ class ContinuousDecodeLoop:
                 m = eng.prefix_cache.match(
                     ids, L, usable=self._chunked_prefix_usable(L)
                 )
+                if m is None and self.paged:
+                    # Host tier: promote a demoted prefix back before
+                    # settling for a cold chunked prefill.
+                    with eng._lock:
+                        m = self._promote_host_prefix(
+                            ids, L, self._chunked_prefix_usable(L)
+                        )
                 if m is not None:
                     p_len, pkv = m
                     if self.paged:
@@ -2294,8 +2502,15 @@ class ContinuousDecodeLoop:
 
         eng = self.engine
         if eng.prefix_cache is not None:
-            while eng.prefix_cache.pop_lru() is not None:
-                pass
+            # Demotion suspended for this flush: the pins name buffers
+            # of the pool being REPLACED, so a copy would read garbage.
+            prev = getattr(eng, "_host_demote_on", True)
+            eng._host_demote_on = False
+            try:
+                while eng.prefix_cache.pop_lru() is not None:
+                    pass
+            finally:
+                eng._host_demote_on = prev
         bs = self.block_size
         nbp = self.pool.num_blocks
 
@@ -2333,6 +2548,11 @@ class ContinuousDecodeLoop:
         )
         self._state = jax.device_put(empty, eng.replicas.batch_sharding)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
+        # Host tier buffers build once the pool leaf shapes are known.
+        tier = self._host_tier()
+        if tier is not None:
+            tier.ensure_pool(self._host_leaf_specs())
+            self._note_host_gauges()
 
     def _hist_row(self, feats: dict, first_toks: np.ndarray) -> np.ndarray:
         """Host-built drafting-history row at the SLOT's width/layout
@@ -2644,6 +2864,481 @@ class ContinuousDecodeLoop:
                 ):
                     raise
 
+    # -- host KV tier (KV_HOST_BUDGET_MB; docs/kv-tiering.md) ----------
+
+    def _host_tier(self):
+        """The engine's KVHostTier when this loop can use it (paged
+        mode, budget > 0); None otherwise.  Read through the engine on
+        every call — a fleet re-points every replica at ONE shared
+        tier, and the tier survives engine rebuilds."""
+        if not self.paged:
+            return None
+        t = getattr(self.engine, "kv_host", None)
+        return t if (t is not None and t.enabled) else None
+
+    def _swap_tokens(self, st: _Stream) -> int | None:
+        e = getattr(st, "swap", None)
+        return e.tokens if (e is not None and e.alive) else None
+
+    def _drop_swap(self, st: _Stream) -> None:
+        """Release a stream's host-tier entry (terminal end, fallback,
+        or a fresh swap-out superseding it).  Safe for entries of a
+        foreign (non-shared) tier — the entry's own ledger frees it."""
+        e = getattr(st, "swap", None)
+        if e is None:
+            return
+        st.swap = None
+        ledger = getattr(e, "ledger", None)
+        if ledger is not None:
+            ledger.release(e)
+        self._note_host_gauges()
+
+    def _note_host_gauges(self) -> None:
+        tier = self._host_tier()
+        if tier is None or tier.pool is None:
+            return
+        name = self.engine.bundle.name
+        metrics.KV_HOST_POOL_BLOCKS.labels(name, "used").set(
+            tier.pool.used_blocks
+        )
+        metrics.KV_HOST_POOL_BLOCKS.labels(name, "free").set(
+            tier.pool.free_blocks
+        )
+
+    def _host_leaf_specs(self):
+        """Per-block (shape, dtype) of every KV pool leaf, in
+        ``jax.tree.leaves((cache_k, cache_v))`` order — the ONE
+        canonical flattening shared by the host buffers and the
+        gather/scatter executables, so a block round-trips by id."""
+        import jax
+
+        leaves = jax.tree.leaves((self._state.cache_k, self._state.cache_v))
+        return [(tuple(x.shape[1:]), x.dtype) for x in leaves]
+
+    def _swap_gather_fn(self):
+        """Jitted device-side block gather: pool[ids] per KV leaf.
+        ``ids`` is padded to a power of two (repeating the last id) so
+        the executable grid stays log2(nb_max), not one per length."""
+        if self._swap_gather_jit is None:
+            import jax
+
+            def gather(state, ids):
+                return jax.tree.map(
+                    lambda pool: pool[ids], (state.cache_k, state.cache_v)
+                )
+
+            self._swap_gather_jit = jax.jit(gather)
+        return self._swap_gather_jit
+
+    def _swap_scatter_fn(self):
+        """Jitted host→device block write: pool.at[ids].set(vals) per
+        KV leaf.  One executable total — every call is padded to the
+        fixed KV_PREFETCH_BLOCKS chunk width."""
+        if self._swap_scatter_jit is None:
+            import jax
+
+            def scatter(state, ids, vals):
+                flat, treedef = jax.tree.flatten(
+                    (state.cache_k, state.cache_v)
+                )
+                new = [
+                    p.at[ids].set(v.astype(p.dtype))
+                    for p, v in zip(flat, vals)
+                ]
+                ck, cv = jax.tree.unflatten(treedef, new)
+                return state._replace(cache_k=ck, cache_v=cv)
+
+            self._swap_scatter_jit = jax.jit(scatter)
+        return self._swap_scatter_jit
+
+    def _gather_to_pending(self, block_ids: list[int]):
+        """Dispatch one padded gather of ``block_ids`` and start the
+        async device→host copies; returns the gathered leaves (their
+        own buffers — they outlive pool rebuilds).  Caller appends to
+        ``_swap_pending`` for materialization at a chunk boundary."""
+        import jax
+
+        nb = len(block_ids)
+        pad = 1 << max(0, nb - 1).bit_length()
+        pids = np.asarray(
+            list(block_ids) + [block_ids[-1]] * (pad - nb), np.int32
+        )
+        with self.engine._lock:
+            leaves = jax.tree.leaves(
+                self._swap_gather_fn()(self._state, pids)
+            )
+        prefetch_to_host(*leaves)
+        return leaves
+
+    def _swap_out(self, st: _Stream) -> None:
+        """Copy the blocks behind this stream's RESUME prompt (feats
+        already rewritten by ``_checkpoint_for_resume``; its KV is the
+        contiguous positions [0, length)) device→host.  One gather
+        dispatch here; the device→host wire time rides asynchronously
+        and materializes at the next chunk boundary.  Every failure
+        path leaves the stream on the recompute resume — the swap is
+        an optimization, never a correctness dependency."""
+        from .kv_blocks import blocks_for
+
+        tier = self._host_tier()
+        eng = self.engine
+        if (
+            tier is None or st.blocks is None or self._state is None
+            or self._swap_hold or st.cancelled.is_set()
+        ):
+            return
+        self._drop_swap(st)  # supersede any stale earlier entry
+        cov = int(st.feats.get("length", 0) or 0)
+        nb = blocks_for(cov, self.block_size)
+        if nb <= 0 or nb > len(st.blocks.ids):
+            return
+        entry = None
+        try:
+            if not tier.ensure_pool(self._host_leaf_specs()):
+                return
+            entry = tier.reserve(nb, cov, kind="stream")
+            if entry is None:
+                return  # host tier too small even after eviction
+            leaves = self._gather_to_pending(list(st.blocks.ids[:nb]))
+        except Exception:
+            log.exception("KV swap-out failed; stream will recompute")
+            if entry is not None:
+                tier.release(entry)
+            return
+        self._swap_pending.append((entry, leaves, nb, None))
+        st.swap = entry
+        self.swap_outs += 1
+        nbytes = nb * self.pool.block_bytes
+        self.swap_out_bytes += nbytes
+        metrics.KV_SWAP_BYTES.labels(eng.bundle.name, "out").inc(nbytes)
+        if self._flight is not None:
+            self._flight.event(
+                "swap_out", rid=st.rid, tokens=cov, blocks=nb
+            )
+        self._note_host_gauges()
+
+    def _drain_swapouts(self) -> None:
+        """Materialize pending device→host copies into the host pool
+        buffers.  Runs at the iteration top (the async copies started
+        at gather time have usually landed — np.asarray is then a
+        local read), before a device rebuild discards the old pools,
+        and before an evacuation hands checkpoints to an adopter."""
+        if not self._swap_pending:
+            return
+        pending, self._swap_pending = self._swap_pending, []
+        for entry, leaves, nb, free_ids in pending:
+            try:
+                if entry.alive:
+                    vals = [np.asarray(x)[:nb] for x in leaves]
+                    entry.pool.write(entry.ids, vals)
+                    entry.ready = True
+            except Exception:
+                log.exception("KV swap materialize failed")
+                ledger = getattr(entry, "ledger", None)
+                if ledger is not None:
+                    ledger.release(entry)
+            finally:
+                if free_ids:
+                    # Demotion: the device refs transferred with the
+                    # queue entry free once the copy is host-resident.
+                    self.pool.free(free_ids)
+                    if self.admission is not None:
+                        self.admission.note_pool()
+        self._note_host_gauges()
+
+    def _drain_demotions(self) -> None:
+        """Gather queued prefix-cache demotions (evicted PagedPrefix
+        pins whose block refs transferred with the queue entry)
+        device→host; the refs free at materialization.  A demotion
+        that cannot land (tier full, state torn down, key already
+        resident) frees its refs immediately — eviction still evicts."""
+        eng = self.engine
+        pending = getattr(eng, "_host_demote_pending", None)
+        if not pending:
+            return
+        eng._host_demote_pending = []
+        tier = self._host_tier()
+
+        def give_back(ids):
+            self.pool.free(ids)
+            if self.admission is not None:
+                self.admission.note_pool()
+
+        for key, pp in pending:
+            ids = list(pp.block_ids)
+            nb = len(ids)
+            entry = None
+            if (
+                tier is not None and self._state is not None and nb > 0
+                and tier.ensure_pool(self._host_leaf_specs())
+                and not tier.prefix_resident(key)
+            ):
+                entry = tier.reserve(nb, pp.p_len, kind="prefix", key=key)
+            if entry is None:
+                give_back(ids)
+                continue
+            try:
+                leaves = self._gather_to_pending(ids)
+            except Exception:
+                log.exception("prefix demotion gather failed")
+                tier.release(entry)
+                give_back(ids)
+                continue
+            nbytes = nb * self.pool.block_bytes
+            self.swap_out_bytes += nbytes
+            metrics.KV_SWAP_BYTES.labels(eng.bundle.name, "out").inc(nbytes)
+            if self._flight is not None:
+                self._flight.event(
+                    "prefix_demote", p_len=pp.p_len, blocks=nb
+                )
+            self._swap_pending.append((entry, leaves, nb, ids))
+
+    def _host_to_device(self, entry, pos: int, dev_ids: list[int]) -> None:
+        """Scatter ``len(dev_ids)`` host blocks (``entry.ids[pos:]``)
+        into the device pools at ``dev_ids``.  Caller holds
+        ``eng._lock``.  Padded to the fixed KV_PREFETCH_BLOCKS chunk
+        (repeating the last block — an idempotent rewrite) so one
+        executable serves every call."""
+        n = len(dev_ids)
+        K = self.swap_chunk_blocks
+        vals = entry.pool.read(entry.ids[pos : pos + n])
+        ids_p = np.asarray(
+            list(dev_ids) + [dev_ids[-1]] * (K - n), np.int32
+        )
+        vals_p = [
+            np.concatenate([v] + [v[-1:]] * (K - n), axis=0)
+            if K > n else v
+            for v in vals
+        ]
+        self._state = self._swap_scatter_fn()(self._state, ids_p, vals_p)
+
+    def _start_swapin(self, st: _Stream) -> bool:
+        """Begin a host→device swap resume: allocate the device blocks
+        the resume prompt needs up front (admission charged exactly
+        them) and queue an incremental prefetch job; the stream goes
+        live through the chunked handoff once every block is copied.
+        Returns False ONLY when the host copy is unusable (evicted,
+        never materialized) — the caller falls back to the recompute
+        admission.  True = handled, including the requeue-on-dry-pool
+        path."""
+        from .kv_blocks import OutOfBlocks, StreamBlocks
+
+        eng = self.engine
+        entry = st.swap
+        tier = self._host_tier()
+        self._drain_swapouts()  # the entry may still be materializing
+        L = int(st.feats["length"])
+        if (
+            entry is None or tier is None or tier.pool is None
+            or entry.pool is not tier.pool or not entry.alive
+            or not entry.ready or entry.tokens != L
+        ):
+            self._drop_swap(st)
+            self.swap_fallbacks += 1
+            metrics.KV_SWAP_RESUMES.labels(
+                eng.bundle.name, "fallback"
+            ).inc()
+            if self._flight is not None:
+                self._flight.event("swap_fallback", rid=st.rid)
+            return False
+        ids = np.asarray(st.feats["input_ids"], np.int32)[:L]
+        st.feats["prefill_mode"] = "swapped"
+        job = _SwapInJob(st, ids, L)
+        job.sb = StreamBlocks(self.pool, self.block_size)
+        try:
+            if self._state is None:
+                self._build_empty_state()
+            eng.fault_point("grow")
+            self._reclaim_then_ensure(job.sb, L)
+        except OutOfBlocks:
+            # Device pool momentarily dry: requeue with the host entry
+            # INTACT — the retry still swap-resumes once blocks free.
+            job.sb.release()
+            metrics.KV_GROWTH_STALLS.labels(eng.bundle.name).inc()
+            if self._flight is not None:
+                self._flight.event(
+                    "kv_growth_stall", rid=st.rid, site="swapin"
+                )
+            if self.admission is not None:
+                self.admission.release(st)
+            self._requeue_preempted(st)
+            return True
+        except Exception as e:
+            job.sb.release()
+            self._fail_streams([st], e)
+            return True
+        st.s_lo = 0
+        # Exact-growth base, like chunked prefill: the restored KV
+        # covers real positions [0, L) only.
+        st.s_base = L
+        job.table_row = np.full(self.nb_max, self.pool.num_blocks, np.int32)
+        job.table_row[: len(job.sb.ids)] = job.sb.ids
+        self._swapping.append(job)
+        if self.admission is not None:
+            self.admission.note_pool()
+        return True
+
+    def _swap_handoff(self, job: _SwapInJob) -> bool:
+        """Flip a fully-prefetched swap job live (the chunked-prefill
+        handoff: w_idx = L-1, pos = 0 — the restored blocks are
+        bit-what a fresh prefill of the resume prompt writes, so the
+        continuation is token-identical)."""
+        st = job.st
+        tokens = st.swap.tokens if st.swap is not None else job.L
+        ok = self._handoff_job(job)
+        if ok:
+            self.swap_ins += 1
+            metrics.KV_SWAP_RESUMES.labels(
+                self.engine.bundle.name, "swapped"
+            ).inc()
+            if self._flight is not None:
+                self._flight.event(
+                    "swap_resume", rid=st.rid, tokens=tokens
+                )
+            self._drop_swap(st)
+        return ok
+
+    def _advance_swapins(self) -> bool:
+        """Copy queued swap-resume jobs' host blocks back into the
+        device pools — ``KV_PREFETCH_BLOCKS`` per iteration while
+        decode streams are live (idle backfill unbounded), riding the
+        same interleave seam as chunked prefill so a resume never
+        stalls live decode for more than one bounded copy.  Returns
+        True when any copy or handoff happened (the loop must not
+        sleep)."""
+        if not self._swapping:
+            return False
+        from ..scheduler.policy import INTERACTIVE
+
+        eng = self.engine
+        advanced = False
+        live = bool(self.active)
+        for job in list(self._swapping):
+            if job.st.cancelled.is_set():
+                self._swapping.remove(job)
+                self._drop_job_resources(job)
+                self._release(job.st)
+        for job in [j for j in self._swapping if j.ready]:
+            if not self.free:
+                break
+            self._swapping.remove(job)
+            if self._swap_handoff(job):
+                advanced = True
+        budget = self.swap_chunk_blocks if live else (1 << 30)
+        jobs = sorted(
+            [j for j in self._swapping if not j.ready],
+            key=lambda j: (
+                0 if j.st.klass == INTERACTIVE else 1, j.t_in,
+            ),
+        )
+        for job in jobs:
+            if budget <= 0:
+                break
+            entry = job.st.swap
+            if entry is None or not entry.alive:
+                # Evicted mid-prefetch (host pressure from newer
+                # swap-outs): drop the half-filled blocks and requeue
+                # on the recompute path.
+                self._swapping.remove(job)
+                self._drop_job_resources(job)
+                self._drop_swap(job.st)
+                self.swap_fallbacks += 1
+                metrics.KV_SWAP_RESUMES.labels(
+                    eng.bundle.name, "fallback"
+                ).inc()
+                if self.admission is not None:
+                    self.admission.release(job.st)
+                self._requeue_preempted(job.st)
+                continue
+            n = len(job.sb.ids)
+            k = min(self.swap_chunk_blocks, n - job.copied, budget)
+            if k > 0:
+                try:
+                    with eng._lock:
+                        self._host_to_device(
+                            entry, job.copied,
+                            job.sb.ids[job.copied : job.copied + k],
+                        )
+                except Exception as e:
+                    self._swapping.remove(job)
+                    self._drop_job_resources(job)
+                    self._fail_streams([job.st], e)
+                    if self._fault_pending is not None:
+                        break
+                    continue
+                job.copied += k
+                budget -= k
+                advanced = True
+                nbytes = k * self.pool.block_bytes
+                self.swap_in_bytes += nbytes
+                self.prefetch_blocks_total += k
+                if live:
+                    self.prefetch_blocks_live += k
+                metrics.KV_SWAP_BYTES.labels(
+                    eng.bundle.name, "in"
+                ).inc(nbytes)
+            if job.copied >= n:
+                job.ready = True
+                if self.free:
+                    self._swapping.remove(job)
+                    if self._swap_handoff(job):
+                        advanced = True
+        return advanced
+
+    def _promote_host_prefix(self, row_ids, L: int, usable):
+        """Host→device prefix promotion on a device-tier miss:
+        allocate fresh blocks, copy the demoted entry's KV back,
+        re-insert the pin — a CoW prefix hit that survived device-
+        budget pressure.  Caller holds ``eng._lock`` (the copy
+        dispatches).  None on any miss or pressure: promotion must
+        never shed or fail a request."""
+        from .kv_blocks import OutOfBlocks, PagedPrefix
+
+        tier = self._host_tier()
+        eng = self.engine
+        if (
+            tier is None or tier.ledger is None or self._state is None
+            or eng.prefix_cache is None
+        ):
+            return None
+        m = eng.prefix_cache.host_lookup(row_ids, L, tier, usable=usable)
+        if m is None:
+            return None
+        p_len, entry = m
+        nb = p_len // self.block_size
+        if nb <= 0 or len(entry.ids) < nb or not entry.ready:
+            return None
+        try:
+            ids = self.pool.alloc(nb)
+        except OutOfBlocks:
+            return None
+        try:
+            for i in range(0, nb, self.swap_chunk_blocks):
+                self._host_to_device(
+                    entry, i, ids[i : i + self.swap_chunk_blocks]
+                )
+        except Exception:
+            log.exception("prefix promotion copy failed")
+            self.pool.free(ids)
+            return None
+        pp = PagedPrefix(p_len, tuple(ids), p_len * eng.kv_token_bytes())
+        # The alloc ref becomes the cache pin.  If the insert evicted
+        # it straight back out (cache budget below one entry), treat
+        # the match as a miss — the blocks freed through on_evict.
+        eng.prefix_cache.insert(row_ids, p_len, pp)
+        if not eng.prefix_cache.contains(row_ids, p_len):
+            return None
+        if self.admission is not None:
+            self.admission.note_pool()
+        self.host_prefix_promotes += 1
+        nbytes = nb * self.pool.block_bytes
+        self.swap_in_bytes += nbytes
+        metrics.KV_SWAP_BYTES.labels(eng.bundle.name, "in").inc(nbytes)
+        metrics.KV_HOST_PREFIX_HITS.labels(eng.bundle.name).inc()
+        if self._flight is not None:
+            self._flight.event("prefix_promote", p_len=p_len, blocks=nb)
+        return p_len, pp
+
     # -- decode --------------------------------------------------------
 
     def _inflight_chunks_ahead(self) -> int:
@@ -2684,7 +3379,9 @@ class ContinuousDecodeLoop:
             for st in self.active.values()
         )
         interactive_waiting = self.queue.waiting(INTERACTIVE) > 0 or any(
-            j.st.klass == INTERACTIVE for j in self._prefilling
+            j.st.klass == INTERACTIVE
+            for jobs in (self._prefilling, self._swapping)
+            for j in jobs
         )
         return self._window_gov.pick(
             max_chunks=-(-need // chunk),
@@ -2751,10 +3448,13 @@ class ContinuousDecodeLoop:
                     self.active.pop(slot)
                     self.sampled_slots.discard(slot)
                     self.free.append(slot)
-                    self._release_blocks(slot, st)
                     if self.admission is not None:
                         self.admission.release(st)
+                    # Checkpoint (and host-tier swap-out) BEFORE the
+                    # block release — the paged dry-pool reclaim no
+                    # longer discards KV the device already computed.
                     self._requeue_preempted(st)
+                    self._release_blocks(slot, st)
                     continue
             if fresh:
                 n = len(st.blocks.ids)

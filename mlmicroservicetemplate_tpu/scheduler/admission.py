@@ -115,7 +115,8 @@ class AdmissionController:
         est = getattr(self.engine, "kv_bytes_estimate", None)
         return int(est(feats)) if est is not None else 0
 
-    def kv_bytes_for_resume(self, feats: dict) -> int:
+    def kv_bytes_for_resume(self, feats: dict,
+                            swap_tokens: int | None = None) -> int:
         """Footprint a checkpointed stream re-reserves at dequeue, off
         its CURRENT feats — the recast resume folds delivered tokens
         into the prompt, so the admission-time estimate can undershoot
@@ -123,8 +124,21 @@ class AdmissionController:
         (chunked prefill: fatal fault, dry pool) holds zero blocks
         while it waits and re-reserves only its first prefill window —
         ``kv_blocks_estimate`` returns the chunked initial, never the
-        whole-prompt estimate."""
+        whole-prompt estimate.
+
+        ``swap_tokens`` (host KV tier, docs/kv-tiering.md): the resume
+        is a host→device block prefetch covering exactly this many
+        token positions, so the reservation is its TRUE cost — the
+        prefetch blocks — not the first-window re-prefill estimate the
+        recompute path would charge."""
         if self.paged and self.pool is not None:
+            if swap_tokens:
+                from ..engine.kv_blocks import blocks_for
+
+                need = blocks_for(
+                    int(swap_tokens), int(self.engine.kv_block_size)
+                )
+                return need * self.pool.block_bytes
             initial, _ = self.engine.kv_blocks_estimate(feats)
             return initial * self.pool.block_bytes
         return self.kv_bytes(feats)

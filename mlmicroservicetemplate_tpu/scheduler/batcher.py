@@ -576,31 +576,55 @@ class Batcher:
                 )
         metrics.BATCH_SIZE.labels(self.model).observe(len(batch))
         t0 = time.monotonic()
+        # Fleet routing for the unary path (ROADMAP item 3 leftover):
+        # the batch dispatch goes to a HEALTHY replica picked by the
+        # same router streams use (prefix affinity is moot here, so the
+        # ladder degrades to health → least-loaded), instead of always
+        # hitting the base engine — a replica with an open breaker no
+        # longer serves /predict, and batch faults feed its breaker.
+        rep = None
+        eng = self.engine
+        if self.fleet is not None:
+            try:
+                rep = self.fleet.pick_batch_replica(feats[0] if feats else {})
+                eng = rep.engine
+            except QueueFullError as e:
+                for item in batch:
+                    item.fail(e)
+                    self.admission.release(item)
+                return
         try:
             # The batch path's dispatch boundary runs under the same
             # fault injector + watchdog as the decode loop's chunks:
             # transients retry with backoff, a hang is cut off at
             # DISPATCH_TIMEOUT_S instead of wedging a worker forever.
             # (Duck-typed engines without a guard dispatch bare.)
-            guard = getattr(self.engine, "dispatch_guard", None)
+            guard = getattr(eng, "dispatch_guard", None)
             if guard is None:
                 rows = await loop.run_in_executor(
-                    self._executor, self.engine.run_batch, feats
+                    self._executor, eng.run_batch, feats
                 )
             else:
                 rows = await loop.run_in_executor(
                     self._executor,
                     lambda: guard(
-                        "batch", lambda: self.engine.run_batch(feats)
+                        "batch", lambda: eng.run_batch(feats)
                     ),
                 )
         except Exception as e:
+            if rep is not None:
+                rep.breaker.record_fault()
+                self.fleet._refresh_gauges()
             for item in batch:
                 item.fail(e)
             return
         finally:
             for item in batch:
                 self.admission.release(item)
+        if rep is not None:
+            # One clean batch dispatch closes the replica's fault
+            # streak, same as a routed-and-fetched stream chunk.
+            rep.breaker.record_ok()
         dt = time.monotonic() - t0
         self._batch_ewma_s = 0.8 * self._batch_ewma_s + 0.2 * dt
         metrics.DEVICE_TIME.labels(self.model).observe(dt)

@@ -37,7 +37,10 @@ def replica_load(replica) -> float:
     + waiting/active stream count.  Works on any object exposing
     ``cdl`` (queue + active) and an optional admission controller."""
     cdl = replica.cdl
-    n = len(cdl.active) + cdl.queue.qsize() + len(cdl._prefilling)
+    n = (
+        len(cdl.active) + cdl.queue.qsize() + len(cdl._prefilling)
+        + len(getattr(cdl, "_swapping", ()))
+    )
     adm = getattr(cdl, "admission", None)
     kv = float(adm.committed_bytes) if adm is not None else 0.0
     # One stream-slot of load per MB committed: coarse, but keeps a

@@ -211,6 +211,22 @@ class ServiceConfig(BaseModel):
     # Tokens per KV block in paged mode.  Must divide every seq bucket
     # (prefix sharing relies on bucket-aligned block boundaries).
     kv_block_size: int = 16
+    # Host-RAM KV tier (docs/kv-tiering.md; requires PAGED_KV=1): MB of
+    # host memory backing swapped-out KV.  Checkpointed streams
+    # (preemption, dry-pool reclaim, supervised crash recovery, fleet
+    # evacuation) copy the blocks behind their resume prompt
+    # device→host instead of freeing-and-recomputing them, and resume
+    # by prefetching the copies back — zero re-prefill chunks; evicted
+    # prefix-cache entries demote here and promote back on a match, so
+    # CoW prefix hits survive device-budget pressure.  0 (default) =
+    # tier off: every checkpoint recomputes exactly as before
+    # (bit-identical paths).
+    kv_host_budget_mb: float = 0.0
+    # Swap-in pacing: host→device block copies per loop iteration while
+    # decode streams are live (idle backfill is unbounded) — the
+    # communication-aware prefetch budget that keeps a resume from
+    # stalling live decode (ChunkFlow, arXiv 2605.11335).
+    kv_prefetch_blocks: int = 4
     # Chunked prefill with prefill–decode interleaving
     # (docs/chunked-prefill.md): prompts longer than PREFILL_CHUNK
     # tokens prefill in PREFILL_CHUNK-token windows interleaved with
@@ -424,6 +440,20 @@ class ServiceConfig(BaseModel):
             )
         return v
 
+    @field_validator("kv_host_budget_mb")
+    @classmethod
+    def _check_kv_host_budget(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("KV_HOST_BUDGET_MB must be >= 0")
+        return v
+
+    @field_validator("kv_prefetch_blocks")
+    @classmethod
+    def _check_kv_prefetch(cls, v: int) -> int:
+        if not (1 <= v <= 4096):
+            raise ValueError("KV_PREFETCH_BLOCKS must be in [1, 4096]")
+        return v
+
     @field_validator("decode_window")
     @classmethod
     def _check_decode_window(cls, v: int) -> int:
@@ -518,7 +548,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       REGISTER_HEARTBEAT_S, CONTINUOUS_BATCHING, PROMPT_PREFIX,
       SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
-      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, PREFILL_CHUNK,
+      DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, KV_HOST_BUDGET_MB,
+      KV_PREFETCH_BLOCKS, PREFILL_CHUNK,
       PREFILL_BUDGET, PREFILL_MAX_PROMPT, DECODE_WINDOW,
       DECODE_WINDOW_AUTO, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
@@ -574,6 +605,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "class_weight": "CLASS_WEIGHT",
         "max_stream_queue": "MAX_STREAM_QUEUE",
         "kv_block_size": "KV_BLOCK_SIZE",
+        "kv_prefetch_blocks": "KV_PREFETCH_BLOCKS",
         "prefill_chunk": "PREFILL_CHUNK",
         "prefill_budget": "PREFILL_BUDGET",
         "prefill_max_prompt": "PREFILL_MAX_PROMPT",
@@ -599,6 +631,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     for field, var in (
         ("deadline_ms", "DEADLINE_MS"),
         ("kv_budget_mb", "KV_BUDGET_MB"),
+        ("kv_host_budget_mb", "KV_HOST_BUDGET_MB"),
         ("drain_grace_s", "DRAIN_GRACE_S"),
         ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
         ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),

@@ -210,6 +210,33 @@ WINDOW_EARLY_EXITS = Counter(
     "because every live row hit EOS",
     ["model"],
 )
+KV_HOST_POOL_BLOCKS = Gauge(
+    "kv_host_pool_blocks",
+    "Host-RAM KV tier blocks by state (KV_HOST_BUDGET_MB; used = "
+    "swapped-out stream checkpoints + demoted prefix entries)",
+    ["model", "state"],
+)
+KV_SWAP_BYTES = Counter(
+    "kv_swap_bytes_total",
+    "KV bytes moved across the device/host tier boundary, by direction "
+    "(out = checkpoint swap-out + prefix demotion, in = resume "
+    "prefetch + prefix promotion)",
+    ["model", "dir"],
+)
+KV_SWAP_RESUMES = Counter(
+    "kv_swap_resumes_total",
+    "Checkpointed-stream resumes by outcome: swapped = KV prefetched "
+    "from the host tier (zero re-prefill), fallback = host copy "
+    "missing/evicted/foreign so the stream re-prefilled (recast or "
+    "replay)",
+    ["model", "outcome"],
+)
+KV_HOST_PREFIX_HITS = Counter(
+    "kv_host_prefix_hits_total",
+    "Prefix-cache matches served from the host tier: the entry was "
+    "demoted under device-budget pressure and promoted back on match",
+    ["model"],
+)
 KV_GROWTH_STALLS = Counter(
     "kv_growth_stalls_total",
     "Paged-KV decode growth found the pool dry: the stream was "

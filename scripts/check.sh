@@ -101,6 +101,23 @@ else
     echo "== fleet-failover smoke skipped (FLEET_SMOKE=0) =="
 fi
 
+# Tiered-KV smoke: the host-RAM swap path under a fatal chunk fault
+# with a tiny KV_HOST_BUDGET_MB — recovery must resume every stream
+# token-identically from the HOST copy, with zero re-prefill chunks
+# (pinned via the loop's prefill-window counter), and both tier
+# ledgers must drain to zero (chaos tier, so it stays out of tier-1).
+# TIER_SMOKE=0 skips.
+if [ "${TIER_SMOKE:-1}" != "0" ]; then
+    echo "== tiered-KV smoke (chunk:fatal@2 + KV_HOST_BUDGET_MB) =="
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        TIER_SMOKE_SPEC="${TIER_SMOKE_SPEC:-chunk:fatal@2}" \
+        TIER_SMOKE_HOST_MB="${TIER_SMOKE_HOST_MB:-0.5}" \
+        python -m pytest tests/test_kv_tier.py::test_tier_smoke \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== tiered-KV smoke skipped (TIER_SMOKE=0) =="
+fi
+
 # Observability smoke: the full HTTP service under TRACE=1 with a
 # transient fault injected, then /debug/trace (schema-valid Perfetto
 # JSON with every stage span) and /debug/engine (flight recorder with

@@ -419,6 +419,34 @@ def _serve_fleet(body, **cfg_kw):
     return asyncio.run(main())
 
 
+def test_unary_batch_path_routes_through_fleet():
+    """ROADMAP item 3 leftover (round 14): /predict batch dispatches
+    go to a HEALTHY replica picked by the router — a dead replica no
+    longer serves the unary path, and an all-dead fleet sheds 503
+    instead of dispatching onto a corpse."""
+
+    async def body(client, batcher):
+        fleet = batcher.fleet
+        r0, r1 = fleet.replicas
+        # Healthy fleet: the pick is a healthy replica.
+        rep = fleet.pick_batch_replica({})
+        assert rep in (r0, r1)
+        # Kill replica 0: every pick lands on replica 1.
+        fleet._mark_dead(r0, "evicted")
+        for _ in range(4):
+            assert fleet.pick_batch_replica({}) is r1
+        resp = await client.post("/predict", json={"text": "hello"})
+        assert resp.status == 200
+        # All dead: the batch path sheds like the stream path.
+        fleet._mark_dead(r1, "evicted")
+        with pytest.raises(QueueFullError, match="every fleet replica"):
+            fleet.pick_batch_replica({})
+        resp = await client.post("/predict", json={"text": "again"})
+        assert resp.status == 503
+
+    _serve_fleet(body)
+
+
 def test_readyz_fleet_degraded_and_all_dead():
     async def body(client, batcher):
         fleet = batcher.fleet
