@@ -169,6 +169,15 @@ async def main() -> None:
             check=False,
         )
 
+    # Durable serving (round-15 tentpole): SIGKILL-mid-traffic recovery
+    # ledger (journal vs none) + journal fsync-policy overhead.
+    # CRASH_AB=0 skips.
+    if os.environ.get("CRASH_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "crash_resume_ab.py")],
+            check=False,
+        )
+
     # Replica fleet (round-13 tentpole): goodput + p99 TTFT through a
     # deterministic replica kill and recovery, FLEET_REPLICAS=2 with
     # token-identical failover vs the single-replica blast radius.

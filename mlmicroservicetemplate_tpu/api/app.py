@@ -125,6 +125,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_post("/predict", handle_predict)
     app.router.add_post("/v1/completions", handle_completions)
     app.router.add_post("/v1/chat/completions", handle_chat_completions)
+    app.router.add_get("/v1/streams/{rid}", handle_stream_attach)
     app.router.add_get("/v1/models", handle_models)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/readyz", handle_readyz)
@@ -191,6 +192,14 @@ async def _on_startup(app: web.Application) -> None:
             return
         app[K_READY].set()
         log.info("model %s ready", app[K_BUNDLE].name)
+        # Durable serving (JOURNAL_DIR): replay the write-ahead journal
+        # AFTER warmup so resumed streams never pay request-path
+        # compiles, re-admitting every incomplete stream for
+        # token-identical continuation (runtime/durability.py).
+        try:
+            await _replay_journal(app)
+        except Exception:
+            log.exception("journal replay failed (serving continues)")
 
     # Tasks land in the K_STATE dict, not the app mapping: aiohttp has
     # frozen the app by the time on_startup fires, and writes to a
@@ -234,7 +243,77 @@ async def _canary(app: web.Application) -> None:
         await coro
 
 
+async def _pump_resumed(rec, gen) -> None:
+    """Drain one journal-resumed stream's continuation into its
+    reconnect record (the stream runs headless — its original client
+    connection died with the old process)."""
+    try:
+        async for chunk in gen:
+            rec.extend(chunk)
+    except Exception as e:
+        rec.fail(str(e) or type(e).__name__)
+    else:
+        rec.complete()
+
+
+async def _replay_journal(app: web.Application) -> None:
+    """Re-admit every incomplete journaled stream through the resume
+    machinery and expose all journaled streams (finished ones too —
+    reconnects are idempotent) at ``GET /v1/streams/{request_id}``."""
+    engine = app[K_ENGINE]
+    journal = getattr(engine, "journal", None)
+    if journal is None:
+        return
+    from ..runtime.durability import StreamRecord, StreamRegistry
+
+    bundle: ModelBundle = app[K_BUNDLE]
+    batcher = app[K_BATCHER]
+    registry = StreamRegistry()
+    app[K_STATE]["streams"] = registry
+    resumed = 0
+    tasks = app[K_STATE].setdefault("_resume_tasks", [])
+    for rs in list(journal.streams.values()):
+        rec = registry.add(StreamRecord(
+            rs.rid, rs.tokens,
+            max_tokens=rs.feats.get("max_tokens"), stop=rs.stop,
+        ))
+        if rs.done:
+            rec.complete()
+            continue
+        try:
+            gen = batcher.resume_stream(rs.np_feats(), rs.tokens)
+        except Exception as e:
+            log.exception("journal replay: could not resume %s", rs.rid)
+            rec.fail(f"resume failed: {e}")
+            metrics.JOURNAL_REPLAY.labels(bundle.name, "failed").inc()
+            continue
+        if gen is None:
+            # The cursor already covers the whole budget: nothing left
+            # to decode — the reconnect serves the journaled tokens.
+            rec.complete()
+            journal.done(rs.rid)
+            metrics.JOURNAL_REPLAY.labels(bundle.name, "complete").inc()
+            continue
+        tasks.append(
+            asyncio.get_running_loop().create_task(_pump_resumed(rec, gen))
+        )
+        metrics.JOURNAL_REPLAY.labels(bundle.name, "resumed").inc()
+        resumed += 1
+    if resumed:
+        log.info(
+            "journal replay: %d incomplete stream(s) re-admitted for "
+            "token-identical resume (reconnect via GET /v1/streams/"
+            "{request_id})", resumed,
+        )
+
+
 async def _on_cleanup(app: web.Application) -> None:
+    for task in app[K_STATE].get("_resume_tasks", ()):
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
     for key in ("_ready_task", "_register_task"):
         task = app[K_STATE].get(key)
         if task is not None:
@@ -400,6 +479,13 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
     # Span/log correlation key for every downstream layer (scheduler
     # queue-wait, prefill windows, stream lifetime).
     feats["request_id"] = request.get("request_id", "")
+    if getattr(app[K_ENGINE], "journal", None) is not None:
+        # Durability annotations (runtime/durability.py): whether the
+        # id came from the client (unary X-Request-Id dedup applies —
+        # minted ids never repeat) and the stop strings (the reconnect
+        # endpoint re-renders deltas with them after a restart).
+        feats["rid_client"] = "X-Request-Id" in request.headers
+        feats["stop_strs"] = list(item.stop)
 
     if stream and bundle.kind == KIND_SEQ2SEQ:
         return await _stream_predict(request, feats, t0, item)
@@ -822,6 +908,9 @@ async def _openai_prologue(request: web.Request, to_prompt):
         raise web.HTTPBadRequest(reason=str(e) or "bad request")
     feats.update(sched)
     feats["request_id"] = request.get("request_id", "")
+    if getattr(app[K_ENGINE], "journal", None) is not None:
+        feats["rid_client"] = "X-Request-Id" in request.headers
+        feats["stop_strs"] = list(item.stop)
     # OpenAI stream semantics: usage appears in a stream ONLY when the
     # client asked via stream_options.include_usage (then every chunk
     # carries "usage": null and one extra final chunk carries the
@@ -1016,6 +1105,90 @@ async def handle_chat_completions(request: web.Request) -> web.StreamResponse:
         }],
         "usage": _usage(feats, n_tok),
     })
+
+
+async def handle_stream_attach(request: web.Request) -> web.StreamResponse:
+    """``GET /v1/streams/{request_id}`` — the crash-reconnect surface
+    (runtime/durability.py): after a process restart, a client whose
+    stream died mid-body re-attaches by request id and drains the
+    journaled tokens plus the live continuation as ndjson deltas —
+    each token exactly once (the resumed decode suppresses everything
+    the journal already holds, so nothing double-emits)."""
+    app = request.app
+    bundle: ModelBundle = app[K_BUNDLE]
+    rid = request.match_info["rid"]
+    registry = app[K_STATE].get("streams")
+    if registry is None:
+        raise web.HTTPNotFound(
+            reason="no journal replay ran (JOURNAL_DIR unset?)"
+        )
+    rec = registry.get(rid)
+    if rec is None:
+        raise web.HTTPNotFound(reason=f"unknown stream {rid!r}")
+    item = RawItem(
+        text="", stream=True, max_tokens=rec.max_tokens,
+        stop=tuple(rec.stop),
+    )
+
+    async def chunks():
+        i = 0
+        while True:
+            cur = len(rec.tokens)
+            if cur > i:
+                yield np.asarray(rec.tokens[i:cur], np.int32)
+                i = cur
+                continue
+            if rec.done:
+                if rec.error:
+                    raise RuntimeError(rec.error)
+                return
+            await rec.wait_past(i)
+
+    events = _delta_stream(bundle, chunks(), item)
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "application/x-ndjson",
+                 "X-Accel-Buffering": "no", "X-Request-Id": rid},
+    )
+    resp.enable_chunked_encoding()
+    await resp.prepare(request)
+    t0 = time.monotonic()
+    try:
+        async for ev in events:
+            if "delta" in ev:
+                await resp.write(
+                    (json.dumps({"delta": ev["delta"]}) + "\n").encode()
+                )
+                continue
+            await resp.write((json.dumps({
+                "done": True,
+                "prediction": {"text": ev["text"]},
+                "tokens_generated": ev["tokens"],
+                "decode_steps": ev["steps"],
+                "finish_reason": ev["finish_reason"],
+                "model": bundle.name,
+                "timing_ms": round((time.monotonic() - t0) * 1000.0, 3),
+            }) + "\n").encode())
+            metrics.REQUESTS.labels(bundle.name, "200").inc()
+    except ConnectionError:
+        pass  # the client can reconnect again; the record persists
+    except Exception as e:
+        metrics.REQUESTS.labels(bundle.name, "500").inc()
+        log.exception("stream reconnect failed (request_id=%s)", rid)
+        try:
+            await resp.write(
+                (json.dumps(_error_body(
+                    type(e).__name__, str(e) or "stream failed", rid
+                )) + "\n").encode()
+            )
+        except ConnectionError:
+            pass
+    finally:
+        try:
+            await resp.write_eof()
+        except ConnectionError:
+            pass
+    return resp
 
 
 async def handle_models(request: web.Request) -> web.Response:
@@ -1222,6 +1395,18 @@ async def handle_status(request: web.Request) -> web.Response:
             "backlog_tokens": cdl.prefill_backlog_tokens(),
             "stall_seconds": round(cdl.prefill_stall_s, 4),
         }
+    journal = getattr(engine, "journal", None)
+    if journal is not None:
+        # Durable serving (JOURNAL_DIR; docs/durability.md): journal
+        # health, the disk KV rung, and the reconnect registry.
+        dur = {"journal": journal.stats()}
+        disk = getattr(engine, "kv_disk", None)
+        if disk is not None:
+            dur["kv_disk"] = disk.stats()
+        reg = app[K_STATE].get("streams")
+        if reg is not None:
+            dur["reconnect"] = reg.stats()
+        body["durability"] = dur
     tr = tracing.tracer()
     body["observability"] = {
         "trace": tr is not None,

@@ -307,6 +307,42 @@ class InferenceEngine:
                     from .kv_blocks import KVHostTier
 
                     self.kv_host = KVHostTier(host_mb, self.kv_block_bytes())
+            # Disk KV tier below host RAM (KV_DISK_BUDGET_MB;
+            # runtime/durability.py): cold host blocks demote to memmap
+            # files under JOURNAL_DIR/kv_disk instead of dying, and
+            # stream checkpoints write through so their resume KV
+            # outlives the process.  Fleet-shared like kv_host.
+            self.kv_disk = None
+            disk_mb = float(getattr(cfg, "kv_disk_budget_mb", 0.0) or 0.0)
+            if disk_mb > 0:
+                jdir = getattr(cfg, "journal_dir", None)
+                if not jdir:
+                    raise ValueError(
+                        "KV_DISK_BUDGET_MB requires JOURNAL_DIR (the "
+                        "disk tier persists under the journal directory)"
+                    )
+                if self.kv_host is None:
+                    raise ValueError(
+                        "KV_DISK_BUDGET_MB requires PAGED_KV=1 and "
+                        "KV_HOST_BUDGET_MB>0 (the disk tier sits BELOW "
+                        "the host-RAM tier in the offload hierarchy)"
+                    )
+                if int(getattr(self, "replica_id", 0)) == 0:
+                    # Process-level registry: two engines over one
+                    # JOURNAL_DIR (fleet rebuilds, probes) share the
+                    # tier instead of racing its index.
+                    from ..runtime.durability import get_disk_tier
+
+                    self.kv_disk = get_disk_tier(
+                        disk_mb, self.kv_block_bytes(),
+                        os.path.join(jdir, "kv_disk"),
+                    )
+                    self.kv_disk.model = bundle.name
+            # Write-ahead stream journal (JOURNAL_DIR; the Batcher
+            # constructs ONE per process and attaches it here; fleet
+            # replicas share it like kv_host).  None = no journaling,
+            # every hook in the serving path short-circuits.
+            self.journal = None
             # Prefix demotions queued by on_evict for the decode loop to
             # gather at its next chunk boundary (the eviction itself
             # must not dispatch: it can run under the cache lock).
@@ -461,6 +497,8 @@ class InferenceEngine:
             self.kv_block_size = int(getattr(cfg, "kv_block_size", 16))
             self.kv_pool = None
             self.kv_host = None
+            self.kv_disk = None
+            self.journal = None
             self._host_demote_pending = []
             self._host_demote_on = True
             self.prefill_chunk = 0
@@ -1015,11 +1053,14 @@ class InferenceEngine:
                 # separately.
                 toks_np, done_np = jax.device_get((toks, state.done))
                 chunk, done = toks_np[0], bool(done_np[0])
-            # Request max_tokens bounds chunk spending (the API layer
-            # trims to the exact token count).
+            # Request max_tokens bounds chunk spending, and the final
+            # chunk trims to the exact budget — raw emission never
+            # overshoots, so the per-stream path stays token-identical
+            # to the continuous loop (which enforces the same cap) for
+            # budgets that are not chunk multiples.
             budget = self.budget_for(feats)
             produced = self.chunk_tokens
-            yield chunk
+            yield chunk[:budget]
             if done:
                 return
             while produced < budget:
@@ -1029,8 +1070,8 @@ class InferenceEngine:
                     )
                     toks_np, done_np = jax.device_get((toks, state.done))
                     chunk, done = toks_np[0], bool(done_np[0])
+                yield chunk[: budget - produced]
                 produced += self.chunk_tokens
-                yield chunk
                 if done:
                     return
         finally:

@@ -269,6 +269,27 @@ class ReplicaFleet:
         if shared_tier is not None:
             for rep in self.replicas[1:]:
                 rep.engine.kv_host = shared_tier
+        # ONE stream journal and ONE disk KV tier for the whole fleet
+        # (runtime/durability.py): the journal is keyed by request id —
+        # replica-agnostic by construction, so an adopter's loop keeps
+        # appending the dead replica's stream cursors — and the disk
+        # tier persists under one JOURNAL_DIR.  The base engine carries
+        # both (the Batcher attaches the journal before building the
+        # fleet; only replica 0 constructs a disk tier).
+        shared_journal = getattr(engine, "journal", None)
+        shared_disk = getattr(engine, "kv_disk", None) or getattr(
+            self.replicas[0].engine, "kv_disk", None
+        )
+        for rep in self.replicas:
+            if getattr(rep.engine, "journal", None) is None:
+                rep.engine.journal = shared_journal
+            old = getattr(rep.engine, "kv_disk", None)
+            if old is not None and old is not shared_disk:
+                # A rebuilt replica-0 engine (split-budget pool) built
+                # its own tier on the SAME directory — two index
+                # handles would corrupt each other; the base's wins.
+                old.close()
+            rep.engine.kv_disk = shared_disk
         self._refresh_gauges()
         log.info(
             "replica fleet up: %d replicas, route=%s, breaker_n=%d, "

@@ -106,6 +106,25 @@ class BlockPool:
                     raise ValueError(f"ref of unallocated block {b}")
                 self._ref[b] += 1
 
+    def take(self, ids: list[int]) -> None:
+        """Claim SPECIFIC free blocks at refcount 1 — the restart-
+        restore path (``runtime/durability.KVDiskTier``): a persisted
+        index names exact block ids, so reconstruction must allocate
+        those ids, not whatever the free list pops.  All-or-nothing;
+        raises on ids that are out of range or already held."""
+        with self._lock:
+            want = set()
+            for b in ids:
+                b = int(b)
+                if not (0 <= b < self.num_blocks):
+                    raise ValueError(f"take of out-of-range block {b}")
+                if self._ref.get(b, 0) > 0 or b in want:
+                    raise ValueError(f"take of already-held block {b}")
+                want.add(b)
+            self._free = deque(b for b in self._free if b not in want)
+            for b in want:
+                self._ref[b] = 1
+
     def free(self, ids: list[int]) -> None:
         """Drop one holder per id; zero-ref blocks rejoin the free
         list.  Unknown/already-free ids raise (a double free is a
@@ -284,6 +303,14 @@ class SwapLedger:
         self._prefix: dict = {}
         self._lock = threading.Lock()
         self.evictions = 0
+        # Tier hooks (runtime/durability.py): ``spill(entry)`` is
+        # offered the victim at LRU eviction so cold blocks demote to
+        # the next tier down instead of dying (best-effort — a spill
+        # failure still evicts); ``on_release`` mirrors entry lifecycle
+        # into the disk tier's persistent index.  Both None (the
+        # default) keep the round-14 behavior exactly.
+        self.spill = None
+        self.on_release = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -308,7 +335,23 @@ class SwapLedger:
                 ids, tokens, kind, key=key, pool=self.pool, ledger=self,
             )
             self._lru[entry] = None
-            if kind == "prefix" and key is not None:
+            if key is not None:
+                self._prefix[key] = entry
+            return entry
+
+    def restore(self, ids: list[int], tokens: int, kind: str,
+                key=None) -> SwapEntry:
+        """Reconstruct one entry at SPECIFIC block ids (restart replay
+        of a persistent tier's index — the blocks' payload already sits
+        in the backing store, so the entry is born ``ready``)."""
+        with self._lock:
+            self.pool.take(ids)
+            entry = SwapEntry(
+                ids, tokens, kind, key=key, pool=self.pool, ledger=self,
+            )
+            entry.ready = True
+            self._lru[entry] = None
+            if key is not None:
                 self._prefix[key] = entry
             return entry
 
@@ -322,6 +365,16 @@ class SwapLedger:
                 victim = e
         if victim is None:
             return False
+        if self.spill is not None and victim.ready:
+            # Demote the cold blocks a tier down before they die.
+            try:
+                self.spill(victim)
+            except Exception:  # pragma: no cover - defensive
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "KV tier spill failed; evicting without demotion"
+                )
         self._release_locked(victim)
         self.evictions += 1
         return True
@@ -334,6 +387,11 @@ class SwapLedger:
         if entry.key is not None:
             self._prefix.pop(entry.key, None)
         self.pool.free(entry.ids)
+        if self.on_release is not None:
+            try:
+                self.on_release(entry)
+            except Exception:  # pragma: no cover - defensive
+                pass
 
     def release(self, entry: SwapEntry) -> None:
         with self._lock:
@@ -346,6 +404,12 @@ class SwapLedger:
 
     def prefix_get(self, key) -> SwapEntry | None:
         """Host-tier prefix lookup by (bucket, content-hash) key;
+        touches LRU recency on hit."""
+        return self.get(key)
+
+    def get(self, key) -> SwapEntry | None:
+        """Keyed lookup for ANY entry kind (stream checkpoints key as
+        ``("stream", rid)`` when a disk tier needs to find them);
         touches LRU recency on hit."""
         with self._lock:
             e = self._prefix.get(key)

@@ -197,7 +197,7 @@ class _SwapInJob:
 
     __slots__ = (
         "st", "ids", "L", "p_len", "sb", "table_row", "copied",
-        "ready", "state", "t_in",
+        "ready", "state", "t_in", "resume_at",
     )
 
     def __init__(self, st: _Stream, ids: np.ndarray, L: int):
@@ -211,6 +211,12 @@ class _SwapInJob:
         self.ready = False
         self.state = None  # _drop_job_resources compatibility
         self.t_in = time.monotonic()
+        # Token position the restored KV covers.  == L for a full
+        # resume (handoff straight to decode); < L for a MID-PREFILL
+        # checkpoint's partial-prompt KV — the job converts into a
+        # chunked-prefill job continuing at this boundary once every
+        # restored block is copied (``_swapin_to_prefill``).
+        self.resume_at = L
 
 
 class ContinuousDecodeLoop:
@@ -596,9 +602,22 @@ class ContinuousDecodeLoop:
                     retry_after_s=self._retry_after_s(),
                 ))
             self._admitted += 1
+            # Write-ahead admission record (runtime/durability.py):
+            # journaled BEFORE the stream can produce anything, so a
+            # SIGKILL at any later point finds it at replay.  None
+            # (JOURNAL_DIR unset) = the pre-durability path exactly.
+            j = self._journal()
+            if j is not None and st.rid:
+                j.admit(st.rid, feats, st.klass, st.budget)
             st.t_queued = time.monotonic()
             self.queue.put(st, force=True)  # bound enforced just above
         self._ensure_thread()
+        return self._consumer_gen(st)
+
+    def _consumer_gen(self, st: _Stream):
+        """The event-loop side of one stream: drain its chunk queue
+        until the terminal sentinel (shared by live admissions and
+        journal-replay resumes)."""
 
         async def gen():
             try:
@@ -636,12 +655,34 @@ class ContinuousDecodeLoop:
         if t is not None and t.is_alive():
             t.join(timeout=30)
 
+    def _journal(self):
+        """The process's write-ahead stream journal when durability is
+        on (JOURNAL_DIR; runtime/durability.py); None otherwise.  Read
+        through the engine on every call — a fleet shares ONE journal
+        across replicas, and it survives engine rebuilds."""
+        return getattr(self.engine, "journal", None)
+
+    def _disk_tier(self):
+        """The disk KV tier once its payload files are attached to the
+        live pool leaf layout (paged mode only); None otherwise."""
+        if not self.paged:
+            return None
+        d = getattr(self.engine, "kv_disk", None)
+        if d is None or not d.enabled or d.pool.leaves is None:
+            return None
+        return d
+
     def _release(self, st: _Stream) -> None:
         """Exactly-once per stream (loop thread, or the event loop for
         a stream that never reached the loop thread)."""
         if not st.released:
             st.released = True
-            self._drop_swap(st)  # terminal: host copy has no reader left
+            j = self._journal()
+            if j is not None and st.rid:
+                # Terminal journal record: replay must not resume this
+                # stream (delivered in full, errored, or cancelled).
+                j.done(st.rid)
+            self._drop_swap(st, disk_too=True)  # terminal: no reader left
             if self.admission is not None:
                 self.admission.release(st)
             dt = time.monotonic() - st.t_in
@@ -1142,6 +1183,9 @@ class ContinuousDecodeLoop:
             recovered += self._checkpoint_requeue(st)
         self._pending_wave = []
         for job in self._prefilling:
+            # Partial-prompt KV swaps out against the pre-fault pools
+            # (skipped under _swap_hold) before the deref below.
+            self._swap_out_job(job)
             if self.paged and job.sb is not None:
                 # Deref into the OLD pool (discarded below) so the
                 # StreamBlocks object can't double-free later.
@@ -1223,7 +1267,7 @@ class ContinuousDecodeLoop:
         THIS replica's pool, count it against this loop's admission,
         queue it.  Called from the dead replica's loop thread."""
         entry = getattr(st, "swap", None)
-        if entry is not None:
+        if entry is not None and not self._is_disk_entry(entry):
             tier = self._host_tier()
             if (
                 tier is None or tier.pool is None
@@ -1231,7 +1275,9 @@ class ContinuousDecodeLoop:
             ):
                 # The checkpoint's host copy lives in a tier this loop
                 # cannot read (non-shared deployment) or died: fall
-                # back to the recast/replay recompute resume.
+                # back to the recast/replay recompute resume.  (Disk-
+                # tier entries — journal-replay resumes — defer to
+                # ``_start_swapin``'s disk→host promotion instead.)
                 self._drop_swap(st)
                 self.swap_fallbacks += 1
                 metrics.KV_SWAP_RESUMES.labels(
@@ -1253,6 +1299,54 @@ class ContinuousDecodeLoop:
         st.t_queued = time.monotonic()
         self.queue.put(st, force=True)
         self._ensure_thread()
+
+    def resume_stream(self, feats: dict, delivered: list[int]):
+        """Journal-replay re-admission (runtime/durability.py): rebuild
+        a crashed process's stream from its journaled admission record
+        and delivered-token cursor, and re-admit it through the SAME
+        checkpoint machinery in-process resumes use — greedy decoder-
+        only streams recast (prompt+delivered re-prefill), everything
+        else replays with the first ``len(delivered)`` tokens
+        suppressed.  Event-loop side; returns the consumer generator
+        (continuation tokens only — the journaled prefix is the
+        reconnect endpoint's to serve), or None when nothing remains
+        to resume (the stream had already delivered its budget)."""
+        st = _Stream(
+            feats, asyncio.get_running_loop(), self.engine.budget_for(feats)
+        )
+        adm = self.admission
+        if adm is not None:
+            klass, _deadline = adm.classify(feats)
+            # Deliberately no deadline: the original one lapsed while
+            # the process was down, and failing the resume on it would
+            # turn a survived crash into a 504.
+            st.klass = klass
+        st.tokens = [int(t) for t in delivered]
+        st.produced = len(st.tokens)
+        # Re-establish the journal record when this journal has never
+        # seen the rid (a normal restart replay compacted the admit in
+        # already; an adopter handed a checkpoint out-of-band has not)
+        # — the continuation's cursor records need a base to extend.
+        j = self._journal()
+        if j is not None and st.rid and st.rid not in j.streams:
+            j.admit(st.rid, feats, st.klass, st.budget)
+            j.tokens(st.rid, st.tokens)
+        if not self._checkpoint_for_resume(st):
+            return None
+        # A disk-tier copy of the checkpoint's resume KV (write-through
+        # spill from a previous life) rides the admission as the swap
+        # entry; ``_start_swapin`` promotes it disk→host→device, and
+        # every failure path lands on the recompute resume.
+        d = getattr(self.engine, "kv_disk", None)
+        if self.paged and d is not None and d.enabled and st.rid:
+            entry = d.get(("stream", st.rid))
+            if (
+                entry is not None and entry.alive
+                and entry.tokens <= int(st.feats["length"])
+            ):
+                st.swap = entry
+        self.adopt_stream(st)
+        return self._consumer_gen(st)
 
     def _harvest_checkpoint(self, st: _Stream) -> _Stream | None:
         """Checkpoint one stream for failover: release this replica's
@@ -1293,8 +1387,10 @@ class ContinuousDecodeLoop:
             h(st)
         self._pending_wave = []
         for job in self._prefilling:
-            # Real frees, not the _recover deref: the pool outlives
-            # this loop and its ledger must read zero afterward.
+            # Partial-prompt KV swaps to the (fleet-shared) host tier
+            # first, then real frees — not the _recover deref: the
+            # pool outlives this loop and its ledger must read zero.
+            self._swap_out_job(job)
             self._drop_job_resources(job)
             h(job.st)
         self._prefilling = []
@@ -1446,6 +1542,14 @@ class ContinuousDecodeLoop:
             return False
         st.started = True
         st.preempted += 1
+        # Journal the checkpoint-site cursor (runtime/durability.py):
+        # every resume — preemption, dry pool, supervised recovery,
+        # fleet evacuation — leaves its delivered-token cursor in the
+        # write-ahead log, so a crash between checkpoint and resume
+        # still replays to the exact same continuation point.
+        j = self._journal()
+        if j is not None and st.rid:
+            j.checkpoint(st.rid)
         greedy = float(st.feats.get("temperature", 0.0)) == 0.0
         ids = np.asarray(st.feats["input_ids"], np.int32)[
             : int(st.feats["length"])
@@ -1520,8 +1624,24 @@ class ContinuousDecodeLoop:
             k = min(st.skip, int(arr.size))
             st.skip -= k
             arr = arr[k:]
+        # Never emit past the budget: a resumed stream whose REMAINING
+        # budget is not chunk-aligned would otherwise deliver the
+        # chunk's overshoot tokens — tokens the uninterrupted run never
+        # produced, breaking reconnect-level token identity (the API's
+        # max_tokens trim cannot catch it: the journal records raw
+        # emissions).
+        room = st.budget - len(st.tokens)
+        if int(arr.size) > room:
+            arr = arr[: max(0, room)]
         if arr.size:
             st.tokens.extend(int(t) for t in arr.tolist())
+            # WRITE-AHEAD cursor: the journal learns about these tokens
+            # before the consumer can — so after a kill, the journaled
+            # cursor always covers everything any client received, and
+            # the reconnect path can dedup with zero double emission.
+            j = self._journal()
+            if j is not None and st.rid:
+                j.tokens(st.rid, arr)
             st.emit(arr)
             self.tokens_emitted += int(arr.size)
             metrics.TOKENS.labels(self.engine.bundle.name).inc(int(arr.size))
@@ -2113,10 +2233,14 @@ class ContinuousDecodeLoop:
 
     def _checkpoint_job(self, job: _PrefillJob) -> bool:
         """Mid-prefill checkpoint: nothing was delivered yet, so resume
-        is a clean token-identical restart through admission.  Blocks
-        release NOW — a waiting checkpoint holds ZERO ledger
-        commitment and re-reserves only its first window at dequeue
+        is a clean token-identical restart through admission.  With a
+        host tier, the partial-prompt KV swaps out FIRST — the resume
+        prefetches it back and re-prefills only the remaining windows
+        (``_swap_out_job``).  Blocks release NOW — a waiting checkpoint
+        holds ZERO ledger commitment and re-reserves only its prefetch
+        (or first-window) footprint at dequeue
         (``kv_bytes_for_resume``), never the whole-prompt estimate."""
+        self._swap_out_job(job)
         self._drop_job_resources(job)
         return self._checkpoint_requeue(job.st)
 
@@ -2553,6 +2677,23 @@ class ContinuousDecodeLoop:
         if tier is not None:
             tier.ensure_pool(self._host_leaf_specs())
             self._note_host_gauges()
+            # Disk rung below it (KV_DISK_BUDGET_MB): attach the memmap
+            # payload files to the live leaf layout (a layout change
+            # wipes stale state) and hook the host ledger's eviction
+            # spill so cold host blocks demote instead of dying.
+            disk = getattr(eng, "kv_disk", None)
+            if (
+                disk is not None and disk.enabled
+                and tier.ledger is not None
+            ):
+                if disk.attach(self._host_leaf_specs()):
+                    tier.ledger.spill = self._spill_host_entry
+                    disk._note_gauges(eng.bundle.name)
+                else:
+                    log.warning(
+                        "disk KV tier: leaf layout mismatch; tier "
+                        "disabled for this process"
+                    )
 
     def _hist_row(self, feats: dict, first_toks: np.ndarray) -> np.ndarray:
         """Host-built drafting-history row at the SLOT's width/layout
@@ -2880,18 +3021,26 @@ class ContinuousDecodeLoop:
         e = getattr(st, "swap", None)
         return e.tokens if (e is not None and e.alive) else None
 
-    def _drop_swap(self, st: _Stream) -> None:
+    def _drop_swap(self, st: _Stream, disk_too: bool = False) -> None:
         """Release a stream's host-tier entry (terminal end, fallback,
         or a fresh swap-out superseding it).  Safe for entries of a
-        foreign (non-shared) tier — the entry's own ledger frees it."""
+        foreign (non-shared) tier — the entry's own ledger frees it.
+        ``disk_too`` (terminal end only) also drops the stream's disk-
+        tier write-through copy: nothing will ever resume it again."""
         e = getattr(st, "swap", None)
-        if e is None:
-            return
-        st.swap = None
-        ledger = getattr(e, "ledger", None)
-        if ledger is not None:
-            ledger.release(e)
-        self._note_host_gauges()
+        if e is not None:
+            st.swap = None
+            ledger = getattr(e, "ledger", None)
+            if ledger is not None:
+                ledger.release(e)
+            self._note_host_gauges()
+        if disk_too and st.rid:
+            d = getattr(self.engine, "kv_disk", None)
+            if d is not None and d.enabled:
+                try:
+                    d.release_key(("stream", st.rid))
+                except Exception:  # pragma: no cover - defensive
+                    log.exception("disk-tier release failed")
 
     def _note_host_gauges(self) -> None:
         tier = self._host_tier()
@@ -2904,6 +3053,24 @@ class ContinuousDecodeLoop:
         metrics.KV_HOST_POOL_BLOCKS.labels(name, "free").set(
             tier.pool.free_blocks
         )
+
+    def _spill_host_entry(self, entry) -> None:
+        """Host-ledger eviction hook (SwapLedger.spill): copy the
+        victim's blocks to the disk tier before they die — host RAM
+        stays the hot rung, disk the cold one.  Runs under the host
+        ledger lock: numpy reads + memmap writes only, never device
+        work."""
+        disk = self._disk_tier()
+        if disk is None or entry.key is None or entry.pool is None:
+            return
+        disk.put(
+            entry.key, entry.tokens, entry.kind,
+            entry.pool.read(entry.ids),
+        )
+        if self._flight is not None:
+            self._flight.event(
+                "disk_spill", kind=entry.kind, blocks=len(entry.ids)
+            )
 
     def _host_leaf_specs(self):
         """Per-block (shape, dtype) of every KV pool leaf, in
@@ -2973,33 +3140,66 @@ class ContinuousDecodeLoop:
     def _swap_out(self, st: _Stream) -> None:
         """Copy the blocks behind this stream's RESUME prompt (feats
         already rewritten by ``_checkpoint_for_resume``; its KV is the
-        contiguous positions [0, length)) device→host.  One gather
-        dispatch here; the device→host wire time rides asynchronously
-        and materializes at the next chunk boundary.  Every failure
-        path leaves the stream on the recompute resume — the swap is
-        an optimization, never a correctness dependency."""
+        contiguous positions [0, length)) device→host."""
+        if st.blocks is None:
+            return
+        self._swap_out_blocks(
+            st, list(st.blocks.ids), int(st.feats.get("length", 0) or 0)
+        )
+
+    def _swap_out_job(self, job) -> None:
+        """Mid-prefill checkpoint swap (the round-14 REMAINING item):
+        the windows already consumed wrote real KV into the job's
+        blocks — copy [0, consumed) device→host BEFORE the blocks
+        release, so the resume prefetches them back and re-prefills
+        only the windows the checkpoint never ran.  ``consumed`` is
+        block-aligned by construction (prefix buckets and
+        PREFILL_CHUNK are both multiples of KV_BLOCK_SIZE), checked
+        anyway because the partial swap-in continues the prefill at
+        exactly that boundary."""
+        st = job.st
+        cov = int(getattr(job, "consumed", 0) or 0)
+        if (
+            not self.paged or getattr(job, "sb", None) is None
+            or cov <= 0 or cov % self.block_size != 0
+        ):
+            return
+        self._swap_out_blocks(st, list(job.sb.ids), cov)
+
+    def _swap_out_blocks(self, st: _Stream, block_ids: list[int],
+                         cov: int) -> None:
+        """Shared swap-out core: copy the first ``blocks_for(cov)`` of
+        ``block_ids`` device→host as this stream's resume KV.  One
+        gather dispatch here; the device→host wire time rides
+        asynchronously and materializes at the next chunk boundary.
+        Every failure path leaves the stream on the recompute resume —
+        the swap is an optimization, never a correctness dependency."""
         from .kv_blocks import blocks_for
 
         tier = self._host_tier()
         eng = self.engine
         if (
-            tier is None or st.blocks is None or self._state is None
+            tier is None or self._state is None
             or self._swap_hold or st.cancelled.is_set()
         ):
             return
         self._drop_swap(st)  # supersede any stale earlier entry
-        cov = int(st.feats.get("length", 0) or 0)
         nb = blocks_for(cov, self.block_size)
-        if nb <= 0 or nb > len(st.blocks.ids):
+        if nb <= 0 or nb > len(block_ids):
             return
         entry = None
         try:
             if not tier.ensure_pool(self._host_leaf_specs()):
                 return
-            entry = tier.reserve(nb, cov, kind="stream")
+            # Keyed by request id so the disk tier's write-through copy
+            # (and a restart's replay lookup) can find it.
+            entry = tier.reserve(
+                nb, cov, kind="stream",
+                key=("stream", st.rid) if st.rid else None,
+            )
             if entry is None:
                 return  # host tier too small even after eviction
-            leaves = self._gather_to_pending(list(st.blocks.ids[:nb]))
+            leaves = self._gather_to_pending(list(block_ids[:nb]))
         except Exception:
             log.exception("KV swap-out failed; stream will recompute")
             if entry is not None:
@@ -3026,12 +3226,30 @@ class ContinuousDecodeLoop:
         if not self._swap_pending:
             return
         pending, self._swap_pending = self._swap_pending, []
+        disk = self._disk_tier()
         for entry, leaves, nb, free_ids in pending:
             try:
                 if entry.alive:
                     vals = [np.asarray(x)[:nb] for x in leaves]
                     entry.pool.write(entry.ids, vals)
                     entry.ready = True
+                    if (
+                        disk is not None and entry.kind == "stream"
+                        and entry.key is not None
+                    ):
+                        # Write-through to the disk rung: the resume KV
+                        # now outlives the PROCESS — a post-restart
+                        # journal replay prefetches it back instead of
+                        # re-prefilling (runtime/durability.py).
+                        try:
+                            disk.put(
+                                entry.key, entry.tokens, "stream", vals
+                            )
+                        except Exception:
+                            log.exception(
+                                "disk write-through failed (resume "
+                                "still host-served)"
+                            )
             except Exception:
                 log.exception("KV swap materialize failed")
                 ledger = getattr(entry, "ledger", None)
@@ -3128,10 +3346,30 @@ class ContinuousDecodeLoop:
         tier = self._host_tier()
         self._drain_swapouts()  # the entry may still be materializing
         L = int(st.feats["length"])
+        if self._is_disk_entry(entry):
+            # Disk rung: the checkpoint's KV survived a host eviction
+            # or a whole process restart — promote it disk→host, then
+            # the normal host→device prefetch path runs unchanged.
+            # (The pool leaf layout the promotion validates against
+            # only exists once the paged state is built — a restart's
+            # first resume arrives before any admission built it.)
+            if self._state is None:
+                self._build_empty_state()
+            entry = self._promote_disk_swap(st, entry)
+            st.swap = entry
+        # A restored MID-PREFILL checkpoint covers only the prompt
+        # windows it had consumed: acceptable when the chunked-prefill
+        # machinery can continue from that (block-aligned) boundary.
+        partial_ok = (
+            entry is not None and entry.tokens < L
+            and bool(self.prefill_chunk) and entry.tokens > 0
+            and entry.tokens % self.block_size == 0
+        )
         if (
             entry is None or tier is None or tier.pool is None
             or entry.pool is not tier.pool or not entry.alive
-            or not entry.ready or entry.tokens != L
+            or not entry.ready
+            or not (entry.tokens == L or partial_ok)
         ):
             self._drop_swap(st)
             self.swap_fallbacks += 1
@@ -3144,12 +3382,16 @@ class ContinuousDecodeLoop:
         ids = np.asarray(st.feats["input_ids"], np.int32)[:L]
         st.feats["prefill_mode"] = "swapped"
         job = _SwapInJob(st, ids, L)
+        job.resume_at = int(entry.tokens)
         job.sb = StreamBlocks(self.pool, self.block_size)
         try:
             if self._state is None:
                 self._build_empty_state()
             eng.fault_point("grow")
-            self._reclaim_then_ensure(job.sb, L)
+            # Allocate exactly the blocks the restored KV covers; a
+            # partial resume grows the rest window-by-window as the
+            # prefill continues.
+            self._reclaim_then_ensure(job.sb, job.resume_at)
         except OutOfBlocks:
             # Device pool momentarily dry: requeue with the host entry
             # INTACT — the retry still swap-resumes once blocks free.
@@ -3177,6 +3419,80 @@ class ContinuousDecodeLoop:
         if self.admission is not None:
             self.admission.note_pool()
         return True
+
+    def _is_disk_entry(self, entry) -> bool:
+        """Whether a swap entry belongs to the DISK tier (journal
+        replay hands these out; ``_start_swapin`` promotes them)."""
+        d = getattr(self.engine, "kv_disk", None)
+        return (
+            entry is not None and d is not None
+            and getattr(entry, "ledger", None) is d.ledger
+        )
+
+    def _promote_disk_swap(self, st: _Stream, entry):
+        """Disk→host promotion of a stream checkpoint's resume KV: a
+        pure host-side copy (memmap read → host-pool write), after
+        which the entry behaves exactly like a fresh swap-out.  None
+        on any miss or pressure — the caller falls back through the
+        normal fallback ladder (recompute resume)."""
+        d = getattr(self.engine, "kv_disk", None)
+        tier = self._host_tier()
+        if (
+            d is None or tier is None or entry is None
+            or not entry.alive or not entry.ready
+        ):
+            return None
+        try:
+            specs = self._host_leaf_specs()
+            if not d.attach(specs) or not tier.ensure_pool(specs):
+                return None
+            host = tier.reserve(
+                len(entry.ids), entry.tokens, kind="stream",
+                key=("stream", st.rid) if st.rid else None,
+            )
+            if host is None:
+                return None
+            tier.pool.write(host.ids, d.pool.read(entry.ids))
+            host.ready = True
+        except Exception:
+            log.exception("disk→host KV promotion failed")
+            return None
+        d.promotes += 1
+        nbytes = len(entry.ids) * self.pool.block_bytes
+        self.swap_in_bytes += nbytes
+        if self._flight is not None:
+            self._flight.event(
+                "disk_promote", rid=st.rid, tokens=entry.tokens,
+                blocks=len(entry.ids),
+            )
+        self._note_host_gauges()
+        return host
+
+    def _swapin_to_prefill(self, job: _SwapInJob) -> None:
+        """A fully-prefetched PARTIAL resume (mid-prefill checkpoint)
+        becomes a chunked-prefill job continuing at the restored
+        boundary: the restored blocks are bit-what the consumed
+        windows wrote, so only the remaining windows re-prefill —
+        the round-14 'mid-prefill checkpoints re-prefill from scratch'
+        negative, closed."""
+        st = job.st
+        self._swapping.remove(job)
+        pj = _PrefillJob(st, job.ids, job.L)
+        pj.p_len = 0
+        pj.consumed = job.resume_at
+        pj.sb, job.sb = job.sb, None
+        pj.table_row = job.table_row
+        self.swap_ins += 1
+        metrics.KV_SWAP_RESUMES.labels(
+            self.engine.bundle.name, "swapped"
+        ).inc()
+        if self._flight is not None:
+            self._flight.event(
+                "swap_resume", rid=st.rid, tokens=job.resume_at,
+                partial=True,
+            )
+        self._drop_swap(st)
+        self._prefilling.append(pj)
 
     def _swap_handoff(self, job: _SwapInJob) -> bool:
         """Flip a fully-prefetched swap job live (the chunked-prefill
@@ -3278,11 +3594,18 @@ class ContinuousDecodeLoop:
                     eng.bundle.name, "in"
                 ).inc(nbytes)
             if job.copied >= n:
-                job.ready = True
-                if self.free:
-                    self._swapping.remove(job)
-                    if self._swap_handoff(job):
-                        advanced = True
+                if job.resume_at < job.L:
+                    # Mid-prefill checkpoint fully restored: continue
+                    # the prefill from the restored boundary instead
+                    # of handing off to decode.
+                    self._swapin_to_prefill(job)
+                    advanced = True
+                else:
+                    job.ready = True
+                    if self.free:
+                        self._swapping.remove(job)
+                        if self._swap_handoff(job):
+                            advanced = True
         return advanced
 
     def _promote_host_prefix(self, row_ids, L: int, usable):
@@ -3302,6 +3625,17 @@ class ContinuousDecodeLoop:
         ):
             return None
         m = eng.prefix_cache.host_lookup(row_ids, L, tier, usable=usable)
+        from_disk = False
+        if m is None:
+            # Disk rung: a prefix demoted out of host RAM under tier
+            # pressure still promotes back — the copy source is the
+            # memmap (entry.pool.read works on either tier).
+            disk = self._disk_tier()
+            if disk is not None:
+                m = eng.prefix_cache.host_lookup(
+                    row_ids, L, disk, usable=usable
+                )
+                from_disk = m is not None
         if m is None:
             return None
         p_len, entry = m
@@ -3331,12 +3665,18 @@ class ContinuousDecodeLoop:
         if self.admission is not None:
             self.admission.note_pool()
         self.host_prefix_promotes += 1
+        if from_disk:
+            d = self._disk_tier()
+            if d is not None:
+                d.promotes += 1
         nbytes = nb * self.pool.block_bytes
         self.swap_in_bytes += nbytes
         metrics.KV_SWAP_BYTES.labels(eng.bundle.name, "in").inc(nbytes)
         metrics.KV_HOST_PREFIX_HITS.labels(eng.bundle.name).inc()
         if self._flight is not None:
-            self._flight.event("prefix_promote", p_len=p_len, blocks=nb)
+            self._flight.event(
+                "prefix_promote", p_len=p_len, blocks=nb, disk=from_disk
+            )
         return p_len, pp
 
     # -- decode --------------------------------------------------------
@@ -3948,11 +4288,65 @@ class ContinuousDecodeLoop:
                 )
                 jax.device_get(toks)
         self._warm_windows(warm_sampled)
+        self._warm_swap()
         if self.prefill_chunk:
             self._warm_prefill()
         if self._auto_depth:
             self._tune_chain_depth_paged()
         self._build_empty_state()
+
+    def _warm_swap(self) -> None:
+        """Compile the host-tier swap executables off the request path
+        (the round-14 honest negative: the FIRST host-tier resume paid
+        a one-off scatter + handoff compile on the request path).
+        Warms the fixed-width host→device scatter, the device→host
+        gather at every power-of-two width the swap-out padder can
+        emit (log2(nb_max) executables, bounded), and — when chunked
+        prefill won't warm it — the paged row handoff the swap resume
+        flips live through."""
+        tier = self._host_tier()
+        if tier is None or not self.paged:
+            return
+        import jax
+
+        eng = self.engine
+        if not tier.ensure_pool(self._host_leaf_specs()):
+            return
+        specs = self._host_leaf_specs()
+        K = self.swap_chunk_blocks
+        ids = np.zeros(K, np.int32)
+        vals = [
+            np.zeros((K,) + tuple(shape), dtype) for shape, dtype in specs
+        ]
+        with eng._lock:
+            # Scatter writes zeros into block 0 of the warm state —
+            # harmless: _build_empty_state resets everything after
+            # warmup, before serving.
+            self._state = self._swap_scatter_fn()(self._state, ids, vals)
+            w = 1
+            cap = 1 << max(0, self.nb_max - 1).bit_length()
+            while w <= cap:
+                self._swap_gather_fn()(self._state, np.zeros(w, np.int32))
+                w *= 2
+            if not self.prefill_chunk:
+                # Swap-resume handoff (chunked deployments warm it in
+                # _warm_prefill; without PREFILL_CHUNK it would compile
+                # on the first resume).
+                sp, _ = eng._collate_sample(
+                    [{"input_ids": np.ones(1, np.int32),
+                      "length": np.int32(1)}], 1
+                )
+                self._state = self._paged_handoff_fn()(
+                    self._state,
+                    np.zeros(
+                        (1, self.nb_max * self.block_size), np.int32
+                    ),
+                    np.zeros(1, np.int32), np.zeros(1, np.int32),
+                    np.zeros(1, np.int32), np.ones(1, bool),
+                    np.zeros((1, eng.max_decode_len), np.int32),
+                    sp, np.int32(0),
+                )
+            jax.block_until_ready(jax.tree.leaves(self._state)[0])
 
     def _warm_windows(self, warm_sampled: bool) -> None:
         """Compile the fused-window executables off the request path:

@@ -1,0 +1,840 @@
+"""Durable serving: crash-safe stream journal + disk KV tier + reconnects.
+
+The fault-tolerance ladder (docs/fault-tolerance.md) ends at the
+process boundary: dispatch retries, supervised engine rebuilds, replica
+failover and host-RAM KV swap all assume the Python process survives.
+A SIGKILL/OOM loses every in-flight stream and the entire prefix/KV
+investment.  This module is the next rung — state that OUTLIVES the
+process:
+
+- **StreamJournal** (``JOURNAL_DIR``): a write-ahead, append-only log
+  of every stream's admission record and delivered-token cursor.  Each
+  record is one JSON object framed by a ``<u32 length><u32 crc32>``
+  header, so a torn tail (the write the kill interrupted) is detected
+  and truncated at replay instead of poisoning the log.  Records are
+  written BEFORE tokens are emitted to the consumer (write-ahead), so
+  the journal cursor always covers everything a client may have seen.
+  On startup the server replays the journal and re-admits every
+  incomplete stream through the existing recast/replay resume paths —
+  token-identical completions after ``kill -9``.
+
+- **KVDiskTier** (``KV_DISK_BUDGET_MB``): a disk block tier BELOW the
+  host-RAM tier (``engine/kv_blocks.KVHostTier``).  Cold host blocks
+  (LRU-evicted swap entries and demoted prefixes) spill here instead
+  of dying, and stream checkpoints write through so their resume KV
+  can outlive the process: a post-restart resume prefetches
+  disk→host→device instead of re-prefilling.  Block payloads live in
+  per-leaf memmap files; entry metadata rides a framed index log with
+  the same torn-tail discipline as the journal.
+
+- **StreamRegistry**: the reconnect surface.  Resumed streams run
+  headless (their original connection died with the process); clients
+  reconnect via ``GET /v1/streams/{request_id}`` and drain the
+  journaled tokens plus the live continuation — exactly once each.
+
+``JOURNAL_DIR`` unset (the default) builds none of this: every hook in
+the serving path is a ``None`` check, bit-identical to the pre-journal
+code (pinned by test).
+
+Durability model: appends hit the OS page cache at ``write()`` time,
+which survives a *process* kill (the chaos contract here) regardless
+of fsync.  ``JOURNAL_FSYNC`` governs survival of a *kernel/power*
+crash: ``always`` fsyncs per record, ``interval`` at most every 50 ms,
+``off`` never.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..utils import metrics
+
+log = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<II")  # payload length, crc32(payload)
+_FSYNC_INTERVAL_S = 0.05
+# Compaction bounds: completed-stream history and unary results kept
+# across restarts (reconnect idempotency) without unbounded growth.
+_KEEP_DONE = 256
+_KEEP_RESULTS = 1024
+
+# The admission-record feats whitelist: everything a token-identical
+# resume needs, nothing engine-internal.  Deadlines are deliberately
+# dropped — a stream that survived a process crash must not 504 on
+# replay because its original deadline lapsed while the server was down.
+_FEAT_KEYS = (
+    "length", "temperature", "top_k", "top_p", "seed", "max_tokens",
+    "priority", "request_id",
+)
+
+
+def append_frame(f, payload: bytes) -> None:
+    """One framed record: header + payload (payload ends with ``\\n``
+    so the log stays greppable)."""
+    f.write(_HDR.pack(len(payload), zlib.crc32(payload)) + payload)
+
+
+def read_frames(path: str) -> tuple[list[bytes], int]:
+    """Every intact record plus the byte offset of the first torn/bad
+    frame (== file size when the log is clean).  A short header, short
+    payload or CRC mismatch ends the scan — everything after a torn
+    write is unreachable by construction (frames are self-delimiting),
+    so the caller truncates there."""
+    out: list[bytes] = []
+    good = 0
+    try:
+        data = open(path, "rb").read()
+    except FileNotFoundError:
+        return out, 0
+    n = len(data)
+    while good + _HDR.size <= n:
+        length, crc = _HDR.unpack_from(data, good)
+        end = good + _HDR.size + length
+        if end > n:
+            break
+        payload = data[good + _HDR.size : end]
+        if zlib.crc32(payload) != crc:
+            break
+        out.append(payload)
+        good = end
+    return out, good
+
+
+class RecoveredStream:
+    """One stream's replayed state: the admission record plus the
+    cumulative delivered-token cursor."""
+
+    __slots__ = (
+        "rid", "feats", "klass", "budget", "tokens", "done", "outcome",
+        "stop",
+    )
+
+    def __init__(self, rid: str, feats: dict, klass: str, budget: int,
+                 stop=()):
+        self.rid = rid
+        self.feats = feats  # JSON-serializable form
+        self.klass = klass
+        self.budget = int(budget)
+        self.tokens: list[int] = []
+        self.done = False
+        self.outcome: str | None = None
+        self.stop = tuple(stop or ())
+
+    def np_feats(self) -> dict:
+        """The feats dict the engine consumes (arrays restored)."""
+        f = dict(self.feats)
+        ids = np.asarray(f.get("input_ids", []), np.int32)
+        f["input_ids"] = ids
+        f["length"] = np.int32(int(f.get("length", ids.size)))
+        return f
+
+
+class StreamJournal:
+    """Write-ahead journal for one serving process (see module doc).
+
+    Thread-safe: the decode loop thread appends token cursors while
+    the event loop appends admissions.  One process owns a journal dir
+    at a time (advisory ``flock`` on ``.lock``) — two servers sharing
+    a journal would interleave frames and corrupt each other's replay.
+    """
+
+    def __init__(self, dir: str, fsync: str = "always", model: str = ""):
+        self.dir = dir
+        self.fsync = str(fsync or "always").lower()
+        self.model = model or "unknown"
+        self._lock = threading.RLock()
+        self._last_fsync = 0.0
+        self.records_written = 0
+        self.torn_bytes = 0
+        os.makedirs(dir, exist_ok=True)
+        self._lockfile = open(os.path.join(dir, ".lock"), "a+")
+        try:
+            import fcntl
+
+            fcntl.flock(self._lockfile, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except ImportError:  # pragma: no cover - non-unix
+            pass
+        except OSError:
+            self._lockfile.close()
+            raise RuntimeError(
+                f"journal dir {dir!r} is locked by another process "
+                "(one server per JOURNAL_DIR)"
+            )
+        # Replay every segment in order, then compact the live state
+        # into a fresh segment and delete the old ones — replay cost
+        # and on-disk size stay proportional to LIVE state, not to
+        # all-time history.
+        self.streams: dict[str, RecoveredStream] = {}
+        self.results: dict[str, list[int]] = {}
+        segs = self._segments()
+        for _, path in segs:
+            frames, good = read_frames(path)
+            sz = os.path.getsize(path)
+            if good < sz:
+                self.torn_bytes += sz - good
+                log.warning(
+                    "journal %s: torn tail (%d bytes) truncated at replay",
+                    path, sz - good,
+                )
+            for payload in frames:
+                try:
+                    self._apply(json.loads(payload))
+                except Exception:
+                    log.exception("journal: unreadable record skipped")
+        nxt = (segs[-1][0] + 1) if segs else 1
+        self._path = os.path.join(dir, f"wal-{nxt:06d}.log")
+        self._f = open(self._path, "ab")
+        self._compact_into_open_segment()
+        for _, path in segs:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- replay --------------------------------------------------------
+
+    def _segments(self) -> list[tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("wal-") and name.endswith(".log"):
+                try:
+                    out.append(
+                        (int(name[4:-4]), os.path.join(self.dir, name))
+                    )
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _apply(self, rec: dict) -> None:
+        k = rec.get("k")
+        rid = str(rec.get("rid", ""))
+        if k == "admit":
+            rs = RecoveredStream(
+                rid, rec.get("feats", {}), rec.get("klass", "interactive"),
+                rec.get("budget", 0), stop=rec.get("stop", ()),
+            )
+            # A compacted admit carries its cumulative cursor; replay
+            # RESETS the rid to it, so a crash mid-compaction (old and
+            # new segments both present) can never double-count deltas.
+            rs.tokens = [int(t) for t in rec.get("delivered", [])]
+            self.streams[rid] = rs
+        elif k == "tokens":
+            rs = self.streams.get(rid)
+            if rs is not None:
+                rs.tokens.extend(int(t) for t in rec.get("t", []))
+        elif k == "done":
+            rs = self.streams.get(rid)
+            if rs is not None:
+                rs.done = True
+                rs.outcome = rec.get("outcome", "end")
+        elif k == "result":
+            self.results[rid] = [int(t) for t in rec.get("row", [])]
+
+    def _compact_into_open_segment(self) -> None:
+        done = [rs for rs in self.streams.values() if rs.done]
+        for rs in done[: max(0, len(done) - _KEEP_DONE)]:
+            self.streams.pop(rs.rid, None)
+        for rid in list(self.results)[: max(0, len(self.results) - _KEEP_RESULTS)]:
+            self.results.pop(rid, None)
+        with self._lock:
+            for rs in self.streams.values():
+                append_frame(self._f, (json.dumps({
+                    "k": "admit", "rid": rs.rid, "feats": rs.feats,
+                    "klass": rs.klass, "budget": rs.budget,
+                    "stop": list(rs.stop), "delivered": rs.tokens,
+                }) + "\n").encode())
+                if rs.done:
+                    append_frame(self._f, (json.dumps({
+                        "k": "done", "rid": rs.rid,
+                        "outcome": rs.outcome or "end",
+                    }) + "\n").encode())
+            for rid, row in self.results.items():
+                append_frame(self._f, (json.dumps({
+                    "k": "result", "rid": rid, "row": row,
+                }) + "\n").encode())
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def incomplete(self) -> list[RecoveredStream]:
+        return [rs for rs in self.streams.values() if not rs.done]
+
+    def lookup_result(self, rid: str) -> list[int] | None:
+        with self._lock:
+            row = self.results.get(rid)
+            return list(row) if row is not None else None
+
+    # -- appends (write-ahead) -----------------------------------------
+
+    def _append(self, kind: str, rec: dict) -> None:
+        payload = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+        with self._lock:
+            if self._f.closed:
+                return
+            append_frame(self._f, payload)
+            self._f.flush()
+            self.records_written += 1
+            now = time.monotonic()
+            if self.fsync == "always" or (
+                self.fsync == "interval"
+                and now - self._last_fsync >= _FSYNC_INTERVAL_S
+            ):
+                t0 = time.perf_counter()
+                os.fsync(self._f.fileno())
+                metrics.JOURNAL_FSYNC.labels(self.model).observe(
+                    time.perf_counter() - t0
+                )
+                self._last_fsync = now
+        metrics.JOURNAL_RECORDS.labels(self.model, kind).inc()
+
+    def admit(self, rid: str, feats: dict, klass: str, budget: int,
+              stop=()) -> None:
+        ids = np.asarray(feats.get("input_ids", []), np.int32)
+        ser: dict = {"input_ids": [int(t) for t in ids.tolist()]}
+        for key in _FEAT_KEYS:
+            v = feats.get(key)
+            if v is not None:
+                ser[key] = (
+                    float(v) if key in ("temperature", "top_p")
+                    else str(v) if key in ("priority", "request_id")
+                    else int(v)
+                )
+        stop = tuple(feats.get("stop_strs") or stop or ())
+        with self._lock:
+            self.streams[rid] = rs = RecoveredStream(
+                rid, ser, klass, budget, stop=stop
+            )
+            rs.done = False
+        self._append("admit", {
+            "k": "admit", "rid": rid, "feats": ser, "klass": klass,
+            "budget": int(budget), "stop": list(stop),
+        })
+
+    def tokens(self, rid: str, toks) -> None:
+        lst = [int(t) for t in np.asarray(toks).reshape(-1).tolist()]
+        if not lst:
+            return
+        with self._lock:
+            rs = self.streams.get(rid)
+            if rs is not None:
+                rs.tokens.extend(lst)
+        self._append("tokens", {"k": "tokens", "rid": rid, "t": lst})
+
+    def checkpoint(self, rid: str) -> None:
+        """Checkpoint-site marker (preemption, dry-pool reclaim,
+        supervised recovery, evacuation): records the journal's own
+        cumulative delivered-token cursor — the continuation point the
+        resume will honor.  Informational at replay (the per-emission
+        ``tokens`` records already carry the cursor), but it makes the
+        journal a readable account of every resume."""
+        with self._lock:
+            rs = self.streams.get(rid)
+            cursor = len(rs.tokens) if rs is not None else 0
+        self._append(
+            "checkpoint",
+            {"k": "checkpoint", "rid": rid, "cursor": cursor},
+        )
+
+    def done(self, rid: str, outcome: str = "end") -> None:
+        with self._lock:
+            rs = self.streams.get(rid)
+            if rs is None or rs.done:
+                return
+            rs.done = True
+            rs.outcome = outcome
+        self._append("done", {"k": "done", "rid": rid, "outcome": outcome})
+
+    def result(self, rid: str, row) -> None:
+        lst = [int(t) for t in np.asarray(row).reshape(-1).tolist()]
+        with self._lock:
+            self.results[rid] = lst
+        self._append("result", {"k": "result", "rid": rid, "row": lst})
+
+    def stats(self) -> dict:
+        with self._lock:
+            inc = sum(1 for r in self.streams.values() if not r.done)
+            return {
+                "dir": self.dir,
+                "fsync": self.fsync,
+                "records_written": self.records_written,
+                "streams_tracked": len(self.streams),
+                "streams_incomplete": inc,
+                "results_kept": len(self.results),
+                "torn_bytes_truncated": self.torn_bytes,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+                self._f.close()
+            try:
+                import fcntl
+
+                fcntl.flock(self._lockfile, fcntl.LOCK_UN)
+            except Exception:
+                pass
+            try:
+                self._lockfile.close()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# disk block tier (KV_DISK_BUDGET_MB) — the rung below host RAM
+
+
+class DiskBlockPool:
+    """Block storage on disk: the ``HostBlockPool`` layout (one buffer
+    per KV pool leaf, ``jax.tree.leaves`` order) backed by memmap files
+    under the journal dir instead of RAM.  Allocation bookkeeping rides
+    the shared ``BlockPool`` free-list/refcount discipline; payloads
+    attach lazily once the leaf shapes are known (the device pools must
+    exist first)."""
+
+    def __init__(self, num_blocks: int, block_bytes: int, dir: str):
+        from ..engine.kv_blocks import BlockPool
+
+        self.book = BlockPool(num_blocks, block_bytes)
+        self.num_blocks = int(num_blocks)
+        self.block_bytes = int(block_bytes)
+        self.dir = dir
+        self.leaves: list | None = None
+        self.leaf_specs: list | None = None
+
+    # BlockPool surface the SwapLedger drives (delegation, not
+    # inheritance: attach-time wipes need to swap the book out).
+    def alloc(self, n):
+        return self.book.alloc(n)
+
+    def free(self, ids):
+        self.book.free(ids)
+
+    def take(self, ids):
+        self.book.take(ids)
+
+    @property
+    def free_blocks(self):
+        return self.book.free_blocks
+
+    @property
+    def used_blocks(self):
+        return self.book.used_blocks
+
+    def attach(self, leaf_specs) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        leaves = []
+        for i, (shape, dtype) in enumerate(leaf_specs):
+            path = os.path.join(self.dir, f"leaf-{i}.dat")
+            full = (self.num_blocks,) + tuple(int(s) for s in shape)
+            nbytes = int(np.prod(full)) * np.dtype(dtype).itemsize
+            mode = (
+                "r+" if os.path.exists(path)
+                and os.path.getsize(path) == nbytes else "w+"
+            )
+            leaves.append(np.memmap(path, dtype=dtype, mode=mode, shape=full))
+        self.leaves = leaves
+        self.leaf_specs = [
+            (tuple(int(s) for s in shape), np.dtype(dtype).str)
+            for shape, dtype in leaf_specs
+        ]
+
+    def write(self, ids: list[int], leaf_vals) -> None:
+        idx = np.asarray(ids, np.int64)
+        for buf, vals in zip(self.leaves, leaf_vals):
+            buf[idx] = vals
+
+    def read(self, ids: list[int]):
+        idx = np.asarray(ids, np.int64)
+        return [np.asarray(buf[idx]) for buf in self.leaves]
+
+    def flush(self) -> None:
+        if self.leaves:
+            for buf in self.leaves:
+                buf.flush()
+
+
+def _json_key(key) -> list:
+    """Disk-index serialization of an entry key: ``("stream", rid)`` or
+    the prefix cache's ``(p_len, blake2b-bytes)``."""
+    if isinstance(key, tuple) and len(key) == 2 and isinstance(key[1], bytes):
+        return ["p", int(key[0]), key[1].hex()]
+    return ["s", str(key[1]) if isinstance(key, tuple) else str(key)]
+
+
+def _from_json_key(j):
+    if not isinstance(j, list) or not j:
+        return None
+    if j[0] == "p" and len(j) == 3:
+        return (int(j[1]), bytes.fromhex(j[2]))
+    if j[0] == "s" and len(j) == 2:
+        return ("stream", j[1])
+    return None
+
+
+# One tier object per directory per process: a second engine built
+# over the same JOURNAL_DIR (fleet replica rebuilds, probe engines)
+# must SHARE the tier, not open a second index handle — two writers
+# compacting one index would orphan each other's appends.
+_DISK_TIERS: dict[str, "KVDiskTier"] = {}
+_DISK_TIERS_LOCK = threading.Lock()
+
+
+def get_disk_tier(budget_mb: float, block_bytes: int,
+                  dir: str) -> "KVDiskTier":
+    """Process-level KVDiskTier registry: the first open of a dir
+    constructs (and index-replays) the tier; later opens return the
+    same object.  ``close()`` evicts, so a genuinely-new tier (tests'
+    simulated restarts) rebuilds from disk."""
+    key = os.path.realpath(dir)
+    with _DISK_TIERS_LOCK:
+        tier = _DISK_TIERS.get(key)
+        if tier is not None:
+            return tier
+        tier = KVDiskTier(budget_mb, block_bytes, dir)
+        tier._registry_key = key
+        _DISK_TIERS[key] = tier
+        return tier
+
+
+class KVDiskTier:
+    """The disk rung of the KV offload hierarchy (ChunkFlow's last
+    tier): entries the host-RAM ledger evicts demote here, and stream
+    checkpoints write through so a resume can outlive the process.
+    Every lookup is keyed — ``("stream", rid)`` for checkpoint KV,
+    the prefix cache's content-hash key for demoted prefixes — and the
+    index log replays across restarts with the journal's torn-tail
+    discipline.  Payload correctness across restarts is guarded by the
+    persisted leaf-spec meta: a config change that alters the block
+    layout wipes the tier instead of scattering garbage KV."""
+
+    def __init__(self, budget_mb: float, block_bytes: int, dir: str):
+        from ..engine.kv_blocks import SwapLedger
+
+        self.budget_bytes = int(float(budget_mb) * 1e6)
+        self.block_bytes = int(block_bytes)
+        self.num_blocks = self.budget_bytes // max(1, self.block_bytes)
+        self.dir = dir
+        self.pool = DiskBlockPool(self.num_blocks, self.block_bytes, dir)
+        self.ledger = SwapLedger(self.pool)
+        self.ledger.on_release = self._index_del
+        self._index_path = os.path.join(dir, "index.log")
+        self._meta_specs = None
+        self._lock = threading.RLock()
+        self._index_f = None
+        self.spills = 0
+        self.promotes = 0
+        os.makedirs(dir, exist_ok=True)
+        self._load_index()
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_blocks > 0
+
+    # -- index ---------------------------------------------------------
+
+    def _load_index(self) -> None:
+        frames, good = read_frames(self._index_path)
+        sz = (
+            os.path.getsize(self._index_path)
+            if os.path.exists(self._index_path) else 0
+        )
+        if good < sz:
+            log.warning("disk-tier index: torn tail truncated at replay")
+        live: dict = {}
+        meta_ok = True
+        for payload in frames:
+            try:
+                rec = json.loads(payload)
+            except Exception:
+                continue
+            op = rec.get("op")
+            if op == "meta":
+                if (
+                    int(rec.get("block_bytes", -1)) != self.block_bytes
+                    or int(rec.get("num_blocks", -1)) > self.num_blocks
+                ):
+                    meta_ok = False
+                    break
+                self._meta_specs = rec.get("leaf_specs")
+            elif op == "put":
+                key = _from_json_key(rec.get("key"))
+                ids = [int(i) for i in rec.get("ids", [])]
+                if key is None or any(i >= self.num_blocks for i in ids):
+                    continue
+                live[_tuple_key(key)] = (
+                    key, ids, int(rec.get("tokens", 0)),
+                    str(rec.get("kind", "stream")),
+                )
+            elif op == "del":
+                key = _from_json_key(rec.get("key"))
+                if key is not None:
+                    live.pop(_tuple_key(key), None)
+        if not meta_ok:
+            self.wipe()
+            live = {}
+        # Rebuild the ledger from the net state, then compact-rewrite
+        # the index so it never grows unbounded across restarts.
+        self.ledger.on_release = None
+        for key, ids, tokens, kind in live.values():
+            try:
+                self.ledger.restore(ids, tokens, kind, key)
+            except Exception:
+                log.exception("disk-tier index: unrestorable entry dropped")
+        self.ledger.on_release = self._index_del
+        self._index_f = open(self._index_path + ".new", "wb")
+        self._index_meta()
+        for key, ids, tokens, kind in live.values():
+            append_frame(self._index_f, (json.dumps({
+                "op": "put", "key": _json_key(key), "ids": ids,
+                "tokens": tokens, "kind": kind,
+            }) + "\n").encode())
+        self._index_f.flush()
+        os.fsync(self._index_f.fileno())
+        self._index_f.close()
+        os.replace(self._index_path + ".new", self._index_path)
+        self._index_f = open(self._index_path, "ab")
+
+    def _index_meta(self) -> None:
+        append_frame(self._index_f, (json.dumps({
+            "op": "meta", "block_bytes": self.block_bytes,
+            "num_blocks": self.num_blocks, "leaf_specs": self._meta_specs,
+        }) + "\n").encode())
+
+    def _index_append(self, rec: dict) -> None:
+        with self._lock:
+            if self._index_f is None or self._index_f.closed:
+                return
+            append_frame(
+                self._index_f, (json.dumps(rec) + "\n").encode()
+            )
+            self._index_f.flush()
+
+    def _index_del(self, entry) -> None:
+        if entry.key is not None:
+            self._index_append({"op": "del", "key": _json_key(entry.key)})
+
+    # -- storage -------------------------------------------------------
+
+    def attach(self, leaf_specs) -> bool:
+        """Open (or validate) the memmap payload files against the
+        live pool leaf layout.  A layout mismatch against persisted
+        entries wipes the tier — stale-config KV must never scatter
+        into the device pools."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            want = [
+                (tuple(int(s) for s in shape), np.dtype(dtype).str)
+                for shape, dtype in leaf_specs
+            ]
+            if self.pool.leaves is not None:
+                return self.pool.leaf_specs == want
+            if self._meta_specs is not None and [
+                (tuple(s), d) for s, d in
+                (tuple(e) for e in self._meta_specs)
+            ] != want:
+                log.warning(
+                    "disk KV tier: leaf layout changed; wiping stale tier"
+                )
+                self.wipe()
+            self.pool.attach(leaf_specs)
+            if self._meta_specs is None:
+                self._meta_specs = [
+                    [list(shape), dtype] for shape, dtype in
+                    self.pool.leaf_specs
+                ]
+                self._index_meta()
+                self._index_f.flush()
+            return True
+
+    def wipe(self) -> None:
+        from ..engine.kv_blocks import SwapLedger
+
+        with self._lock:
+            for name in list(os.listdir(self.dir)):
+                if name.startswith("leaf-") or name.startswith("index.log"):
+                    try:
+                        os.unlink(os.path.join(self.dir, name))
+                    except OSError:
+                        pass
+            self.pool = DiskBlockPool(
+                self.num_blocks, self.block_bytes, self.dir
+            )
+            self.ledger = SwapLedger(self.pool)
+            self.ledger.on_release = self._index_del
+            self._meta_specs = None
+            if self._index_f is not None and not self._index_f.closed:
+                self._index_f.close()
+            self._index_f = open(self._index_path, "ab")
+
+    # -- entries -------------------------------------------------------
+
+    def put(self, key, tokens: int, kind: str, leaf_vals):
+        """Store one entry's blocks (superseding any older entry at the
+        same key); None when the tier cannot hold it even after LRU
+        eviction.  ``leaf_vals[i]`` is ``[n_blocks, block, ...]`` in
+        pool-leaf order — exactly what ``HostBlockPool.read`` returns,
+        so host→disk demotion is one call."""
+        if self.pool.leaves is None:
+            return None
+        n = int(leaf_vals[0].shape[0]) if leaf_vals else 0
+        with self._lock:
+            old = self.ledger.get(key)
+            if old is not None:
+                self.ledger.release(old)
+            entry = self.ledger.reserve(n, tokens, kind, key=key)
+            if entry is None:
+                return None
+            try:
+                self.pool.write(entry.ids, leaf_vals)
+            except Exception:
+                log.exception("disk KV tier: write failed")
+                self.ledger.release(entry)
+                return None
+            entry.ready = True
+            self._index_append({
+                "op": "put", "key": _json_key(key), "ids": entry.ids,
+                "tokens": int(tokens), "kind": kind,
+            })
+            self.spills += 1
+        self._note_gauges()
+        return entry
+
+    def get(self, key):
+        return self.ledger.get(key)
+
+    def prefix_get(self, key):
+        """Duck-typed ``KVHostTier.prefix_get`` so the prefix cache's
+        ``host_lookup`` can probe the disk rung with the same call —
+        but only once the payload files are attached (a metadata-only
+        hit would promise KV this process cannot read yet)."""
+        if self.pool.leaves is None:
+            return None
+        return self.ledger.get(key)
+
+    def release(self, entry) -> None:
+        self.ledger.release(entry)
+        self._note_gauges()
+
+    def release_key(self, key) -> None:
+        e = self.ledger.get(key)
+        if e is not None:
+            self.ledger.release(e)
+            self._note_gauges()
+
+    def _note_gauges(self, model: str | None = None) -> None:
+        m = model or getattr(self, "model", None) or "unknown"
+        metrics.KV_DISK_POOL_BLOCKS.labels(m, "used").set(
+            self.pool.used_blocks
+        )
+        metrics.KV_DISK_POOL_BLOCKS.labels(m, "free").set(
+            self.pool.free_blocks
+        )
+
+    def stats(self) -> dict:
+        base = {
+            "budget_bytes": self.budget_bytes,
+            "block_bytes": self.block_bytes,
+            "num_blocks": self.num_blocks,
+            "spills": self.spills,
+            "promotes": self.promotes,
+            "attached": self.pool.leaves is not None,
+        }
+        base.update(self.ledger.stats())
+        return base
+
+    def close(self) -> None:
+        with self._lock:
+            self.pool.flush()
+            if self._index_f is not None and not self._index_f.closed:
+                self._index_f.flush()
+                self._index_f.close()
+        key = getattr(self, "_registry_key", None)
+        if key is not None:
+            with _DISK_TIERS_LOCK:
+                if _DISK_TIERS.get(key) is self:
+                    del _DISK_TIERS[key]
+
+
+def _tuple_key(key):
+    return key if isinstance(key, tuple) else ("stream", str(key))
+
+
+# ---------------------------------------------------------------------------
+# reconnect registry (GET /v1/streams/{request_id})
+
+
+class StreamRecord:
+    """One resumed stream's reconnect state: journaled tokens + the
+    live continuation, on the server's event loop."""
+
+    def __init__(self, rid: str, tokens: list[int], max_tokens=None,
+                 stop=()):
+        self.rid = rid
+        self.tokens = list(tokens)
+        self.max_tokens = max_tokens
+        self.stop = tuple(stop or ())
+        self.done = False
+        self.error: str | None = None
+        self._waiters: list = []
+
+    def _wake(self) -> None:
+        for fut in self._waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._waiters = []
+
+    def extend(self, toks) -> None:
+        self.tokens.extend(int(t) for t in np.asarray(toks).reshape(-1))
+        self._wake()
+
+    def complete(self) -> None:
+        self.done = True
+        self._wake()
+
+    def fail(self, msg: str) -> None:
+        self.error = msg
+        self.done = True
+        self._wake()
+
+    async def wait_past(self, n: int) -> None:
+        """Block until more than ``n`` tokens exist or the stream ends."""
+        import asyncio
+
+        while len(self.tokens) <= n and not self.done:
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.append(fut)
+            if len(self.tokens) > n or self.done:
+                fut.cancel()
+                return
+            await fut
+
+
+class StreamRegistry:
+    """rid → StreamRecord for every journal-resumed stream."""
+
+    def __init__(self):
+        self._records: dict[str, StreamRecord] = {}
+
+    def add(self, rec: StreamRecord) -> StreamRecord:
+        self._records[rec.rid] = rec
+        return rec
+
+    def get(self, rid: str) -> StreamRecord | None:
+        return self._records.get(rid)
+
+    def stats(self) -> dict:
+        live = sum(1 for r in self._records.values() if not r.done)
+        return {"streams": len(self._records), "live": live}

@@ -130,7 +130,13 @@ class AdmissionController:
         is a host→device block prefetch covering exactly this many
         token positions, so the reservation is its TRUE cost — the
         prefetch blocks — not the first-window re-prefill estimate the
-        recompute path would charge."""
+        recompute path would charge.  This covers every swap shape:
+        full resume prompts, MID-PREFILL checkpoints (swap_tokens =
+        the consumed prefix, which continues growing window-by-window
+        after the prefetch), and journal-replay resumes whose KV
+        promotes disk→host→device after a process restart
+        (docs/durability.md) — the charge is always the blocks the
+        prefetch will allocate up front."""
         if self.paged and self.pool is not None:
             if swap_tokens:
                 from ..engine.kv_blocks import blocks_for

@@ -118,6 +118,27 @@ class Batcher:
         self._task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
         self._closed = False
+        # Durable serving (JOURNAL_DIR; runtime/durability.py): ONE
+        # write-ahead stream journal per process, attached to the
+        # engine so the decode loop's hooks find it; a fleet shares it
+        # (engine/fleet.py re-points every replica).  Constructed
+        # BEFORE the fleet below so replica engines inherit it.  Unset
+        # (default) = no journal object anywhere, every path
+        # bit-identical.
+        self._owns_journal = False
+        jdir = getattr(cfg, "journal_dir", None)
+        if (
+            jdir
+            and getattr(engine.bundle, "kind", None) == "seq2seq"
+            and getattr(engine, "journal", None) is None
+        ):
+            from ..runtime.durability import StreamJournal
+
+            engine.journal = StreamJournal(
+                jdir, fsync=getattr(cfg, "journal_fsync", "always"),
+                model=engine.bundle.name,
+            )
+            self._owns_journal = True
         # Continuous batching (default): concurrent generative streams
         # share ONE batched decode dispatch instead of holding a worker
         # each (engine/streams.py).  CONTINUOUS_BATCHING=0 falls back to
@@ -198,6 +219,13 @@ class Batcher:
             await asyncio.get_running_loop().run_in_executor(None, self._cdl.stop)
         self._executor.shutdown(wait=False)
         self._stream_executor.shutdown(wait=False)
+        if self._owns_journal:
+            j = getattr(self.engine, "journal", None)
+            if j is not None:
+                j.close()
+            d = getattr(self.engine, "kv_disk", None)
+            if d is not None:
+                d.close()
 
     def warmup(self) -> None:
         """Blocking: compile the continuous-batching executables (slot
@@ -280,6 +308,18 @@ class Batcher:
         ``DeadlineExceededError`` (504)."""
         if self._closed:
             raise RuntimeError("batcher is stopped")
+        # Idempotent unary retries (runtime/durability.py): a CLIENT-
+        # SUPPLIED X-Request-Id whose result was journaled before a
+        # crash returns the journaled row — the retry after a restart
+        # costs a lookup, not a recompute, and can never produce a
+        # different completion.  (Minted ids never repeat, so the API
+        # layer only flags client-supplied ones.)
+        j = getattr(self.engine, "journal", None)
+        rid = str(feats.get("request_id") or "")
+        if j is not None and rid and feats.get("rid_client"):
+            cached = j.lookup_result(rid)
+            if cached is not None:
+                return np.asarray(cached, np.int32)
         klass, deadline = self.admission.classify(feats)
         try:
             klass, kv = self.admission.admit(feats, klass)
@@ -453,6 +493,31 @@ class Batcher:
                 cancelled.set()
 
         return gen()
+
+    def resume_stream(self, feats: dict, delivered: list[int]):
+        """Journal-replay resume routing: hand a recovered checkpoint
+        to the continuous loop (or, under a fleet, to the replica the
+        router picks — the adopter-side resume).  Returns the
+        continuation generator, or None when the stream had already
+        delivered its budget.  Raises RuntimeError when no continuous
+        loop exists to resume on (CONTINUOUS_BATCHING=0)."""
+        if self.fleet is not None:
+            healthy = self.fleet.healthy_replicas()
+            last: Exception | None = None
+            for rep in self.fleet.router.order(healthy, feats):
+                try:
+                    return rep.cdl.resume_stream(feats, delivered)
+                except (QueueFullError, RuntimeError) as e:
+                    last = e
+            raise last if last is not None else RuntimeError(
+                "no healthy replica to resume on"
+            )
+        if self._cdl is None:
+            raise RuntimeError(
+                "journal replay needs the continuous decode loop "
+                "(CONTINUOUS_BATCHING=1)"
+            )
+        return self._cdl.resume_stream(feats, delivered)
 
     # ------------------------------------------------------------------
     def _expire(self) -> None:
@@ -628,7 +693,15 @@ class Batcher:
         dt = time.monotonic() - t0
         self._batch_ewma_s = 0.8 * self._batch_ewma_s + 0.2 * dt
         metrics.DEVICE_TIME.labels(self.model).observe(dt)
+        j = getattr(self.engine, "journal", None)
         for item, row in zip(batch, rows):
+            if j is not None and item.feats.get("rid_client"):
+                rid = str(item.feats.get("request_id") or "")
+                arr = np.asarray(row)
+                if rid and np.issubdtype(arr.dtype, np.integer):
+                    # Journal the completion for X-Request-Id dedup
+                    # (token rows only — the generative unary path).
+                    j.result(rid, arr)
             if not item.future.done():
                 item.future.set_result(row)
 

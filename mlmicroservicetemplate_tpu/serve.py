@@ -32,6 +32,10 @@ def parse_args(argv: list[str] | None = None) -> dict:
     p.add_argument("--max-batch", dest="MAX_BATCH")
     p.add_argument("--batch-timeout-ms", dest="BATCH_TIMEOUT_MS")
     p.add_argument("--replicas", dest="REPLICAS")
+    p.add_argument(
+        "--journal-dir", dest="JOURNAL_DIR",
+        help="crash-safe stream journal directory (docs/durability.md)",
+    )
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--server-url", dest="SERVER_URL")
     args = p.parse_args(argv)
@@ -127,6 +131,16 @@ def main(argv: list[str] | None = None) -> None:
         "serving %s on %s:%d (device=%s, max_batch=%d)",
         bundle.name, cfg.host, cfg.port, cfg.device, cfg.max_batch,
     )
+    if cfg.journal_dir:
+        # Durable serving: the startup replay (api/app.py) re-admits
+        # every incomplete journaled stream once the model is ready.
+        log.info(
+            "durability: write-ahead journal at %s (fsync=%s, "
+            "disk KV tier=%s)",
+            cfg.journal_dir, cfg.journal_fsync,
+            f"{cfg.kv_disk_budget_mb:g}MB"
+            if cfg.kv_disk_budget_mb else "off",
+        )
     asyncio.run(_serve_until_signalled(app, cfg))
 
 

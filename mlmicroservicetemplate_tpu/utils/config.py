@@ -227,6 +227,29 @@ class ServiceConfig(BaseModel):
     # communication-aware prefetch budget that keeps a resume from
     # stalling live decode (ChunkFlow, arXiv 2605.11335).
     kv_prefetch_blocks: int = 4
+    # Durable serving (runtime/durability.py; docs/durability.md).
+    # Directory for the crash-safe write-ahead stream journal: every
+    # stream's admission record and delivered-token cursor append here
+    # (length/CRC-framed JSONL) BEFORE tokens reach the client, and on
+    # startup the server replays the journal and re-admits every
+    # incomplete stream for token-identical resume after kill -9.
+    # Clients reconnect via GET /v1/streams/{request_id}; unary
+    # /predict retries dedup by X-Request-Id against journaled
+    # results.  Unset (default) = no journal, every path bit-identical
+    # to the pre-durability code.
+    journal_dir: str | None = None
+    # Journal fsync policy: "always" (fsync per record — survives
+    # kernel/power crashes), "interval" (fsync at most every 50 ms),
+    # "off" (OS page cache only — still survives a PROCESS kill, which
+    # is the kill -9 contract; not a host crash).
+    journal_fsync: str = "always"
+    # Disk KV tier below the host-RAM tier (requires PAGED_KV=1,
+    # KV_HOST_BUDGET_MB>0 and JOURNAL_DIR): cold host blocks (LRU-
+    # evicted swaps, demoted prefixes) spill to memmap files under
+    # JOURNAL_DIR/kv_disk instead of dying, and stream checkpoints
+    # write through so their resume KV outlives the process.  0
+    # (default) = no disk tier.
+    kv_disk_budget_mb: float = 0.0
     # Chunked prefill with prefill–decode interleaving
     # (docs/chunked-prefill.md): prompts longer than PREFILL_CHUNK
     # tokens prefill in PREFILL_CHUNK-token windows interleaved with
@@ -447,6 +470,24 @@ class ServiceConfig(BaseModel):
             raise ValueError("KV_HOST_BUDGET_MB must be >= 0")
         return v
 
+    @field_validator("kv_disk_budget_mb")
+    @classmethod
+    def _check_kv_disk_budget(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("KV_DISK_BUDGET_MB must be >= 0")
+        return v
+
+    @field_validator("journal_fsync")
+    @classmethod
+    def _check_journal_fsync(cls, v: str) -> str:
+        v = v.lower()
+        if v not in ("always", "interval", "off"):
+            raise ValueError(
+                f"JOURNAL_FSYNC must be 'always', 'interval' or 'off', "
+                f"got {v!r}"
+            )
+        return v
+
     @field_validator("kv_prefetch_blocks")
     @classmethod
     def _check_kv_prefetch(cls, v: int) -> int:
@@ -549,6 +590,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       SPEC_DECODE, SPEC_K, SPEC_NGRAM, PRIORITY_DEFAULT, DEADLINE_MS,
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
       DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, KV_HOST_BUDGET_MB,
+      KV_DISK_BUDGET_MB, JOURNAL_DIR, JOURNAL_FSYNC,
       KV_PREFETCH_BLOCKS, PREFILL_CHUNK,
       PREFILL_BUDGET, PREFILL_MAX_PROMPT, DECODE_WINDOW,
       DECODE_WINDOW_AUTO, FAULT_SPEC, FAULT_SEED,
@@ -583,6 +625,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "fault_spec": "FAULT_SPEC",
         "log_format": "LOG_FORMAT",
         "profile_dir": "PROFILE_DIR",
+        "journal_dir": "JOURNAL_DIR",
+        "journal_fsync": "JOURNAL_FSYNC",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -632,6 +676,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("deadline_ms", "DEADLINE_MS"),
         ("kv_budget_mb", "KV_BUDGET_MB"),
         ("kv_host_budget_mb", "KV_HOST_BUDGET_MB"),
+        ("kv_disk_budget_mb", "KV_DISK_BUDGET_MB"),
         ("drain_grace_s", "DRAIN_GRACE_S"),
         ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
         ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),

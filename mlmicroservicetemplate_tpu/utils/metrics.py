@@ -237,6 +237,29 @@ KV_HOST_PREFIX_HITS = Counter(
     "demoted under device-budget pressure and promoted back on match",
     ["model"],
 )
+JOURNAL_RECORDS = Counter(
+    "journal_records_total",
+    "Write-ahead stream-journal records appended, by kind (admit = "
+    "stream admission, tokens = delivered-token cursor delta, done = "
+    "terminal, result = unary /predict completion for X-Request-Id "
+    "dedup)",
+    ["model", "kind"],
+)
+JOURNAL_REPLAY = Counter(
+    "journal_replay_streams_total",
+    "Journaled streams processed at startup replay, by outcome "
+    "(resumed = re-admitted for token-identical continuation, "
+    "complete = already finished before the crash, failed = could not "
+    "re-admit)",
+    ["model", "outcome"],
+)
+KV_DISK_POOL_BLOCKS = Gauge(
+    "kv_disk_pool_blocks",
+    "Disk KV tier blocks by state (KV_DISK_BUDGET_MB; used = spilled "
+    "stream checkpoints + demoted prefix entries persisted under "
+    "JOURNAL_DIR/kv_disk)",
+    ["model", "state"],
+)
 KV_GROWTH_STALLS = Counter(
     "kv_growth_stalls_total",
     "Paged-KV decode growth found the pool dry: the stream was "
@@ -258,6 +281,13 @@ DISPATCH_HOST = Histogram(
     "fetch | batch) — the host-side half of the host-vs-device "
     "attribution split (TRACE=1 spans carry the device half)",
     ["model", "site"], buckets=_FINE_BUCKETS,
+)
+JOURNAL_FSYNC = Histogram(
+    "journal_fsync_seconds",
+    "Wall time per journal fsync (JOURNAL_FSYNC=always pays one per "
+    "record on the delivery path; interval amortizes; off never "
+    "observes here)",
+    ["model"], buckets=_FINE_BUCKETS,
 )
 TBT = Histogram(
     "stream_tbt_seconds",

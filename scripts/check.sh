@@ -118,6 +118,22 @@ else
     echo "== tiered-KV smoke skipped (TIER_SMOKE=0) =="
 fi
 
+# Crash smoke: a REAL serving process with JOURNAL_DIR is SIGKILLed
+# mid-stream, restarted on the same journal, and the reconnect
+# (GET /v1/streams/{request_id}) must drain a token-identical body —
+# zero lost streams, zero duplicated tokens (chaos tier, so it stays
+# out of tier-1).  CRASH_SMOKE=0 skips; CRASH_SMOKE_FSYNC overrides
+# the journal fsync policy under test (default always).
+if [ "${CRASH_SMOKE:-1}" != "0" ]; then
+    echo "== crash smoke (SIGKILL mid-stream + journal replay) =="
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        CRASH_SMOKE_FSYNC="${CRASH_SMOKE_FSYNC:-always}" \
+        python -m pytest tests/test_durability.py::test_crash_smoke \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== crash smoke skipped (CRASH_SMOKE=0) =="
+fi
+
 # Observability smoke: the full HTTP service under TRACE=1 with a
 # transient fault injected, then /debug/trace (schema-valid Perfetto
 # JSON with every stage span) and /debug/engine (flight recorder with
