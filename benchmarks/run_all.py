@@ -178,6 +178,15 @@ async def main() -> None:
             check=False,
         )
 
+    # Bulk jobs (round-16 tentpole): interactive p99 TTFT with a
+    # /v1/batches job backfilling idle compute vs interactive-only,
+    # plus the bulk tokens/s reclaimed.  JOBS_AB=0 skips.
+    if os.environ.get("JOBS_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "bulk_jobs_ab.py")],
+            check=False,
+        )
+
     # Replica fleet (round-13 tentpole): goodput + p99 TTFT through a
     # deterministic replica kill and recovery, FLEET_REPLICAS=2 with
     # token-identical failover vs the single-replica blast radius.

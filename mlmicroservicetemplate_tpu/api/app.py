@@ -135,6 +135,14 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_get("/debug/engine", handle_engine_debug)
     app.router.add_post("/debug/profile", handle_profile)
 
+    # Bulk inference lane (JOBS_ENABLED; jobs/api.py): the /v1/batches
+    # routes exist only when the Batcher built a JobManager — with the
+    # knob unset the HTTP surface is bit-identical to pre-jobs serving.
+    if getattr(batcher, "jobs", None) is not None:
+        from ..jobs.api import add_job_routes
+
+        add_job_routes(app, batcher.jobs)
+
     # A misconfigured CHAT_TEMPLATE must fail at STARTUP, not as
     # request-time 500s once the server already passed /readyz.
     from .chat import TEMPLATES, validate_chat_template
@@ -200,6 +208,15 @@ async def _on_startup(app: web.Application) -> None:
             await _replay_journal(app)
         except Exception:
             log.exception("journal replay failed (serving continues)")
+        # Bulk jobs (JOBS_ENABLED): re-admit every incomplete job from
+        # its last completed line — after the stream replay, so resumed
+        # interactive streams claim capacity before bulk backfill does.
+        try:
+            jobs = getattr(app[K_BATCHER], "jobs", None)
+            if jobs is not None:
+                jobs.replay()
+        except Exception:
+            log.exception("job replay failed (serving continues)")
 
     # Tasks land in the K_STATE dict, not the app mapping: aiohttp has
     # frozen the app by the time on_startup fires, and writes to a
@@ -1124,6 +1141,25 @@ async def handle_stream_attach(request: web.Request) -> web.StreamResponse:
         )
     rec = registry.get(rid)
     if rec is None:
+        # Never-seen vs finished-and-forgotten: a rid whose terminal
+        # status is still journaled (live record or compacted
+        # tombstone) gets 410 — "already finished, history gone" — so
+        # a reconnecting client stops retrying; an unknown rid is a
+        # plain 404 ("wrong id").
+        journal = getattr(app[K_ENGINE], "journal", None)
+        outcome = (
+            journal.terminal_status(rid) if journal is not None else None
+        )
+        if outcome is not None:
+            raise web.HTTPGone(
+                text=json.dumps({
+                    "request_id": rid,
+                    "terminal": outcome,
+                    "detail": "stream completed; its token history was "
+                              "compacted out of the journal",
+                }),
+                content_type="application/json",
+            )
         raise web.HTTPNotFound(reason=f"unknown stream {rid!r}")
     item = RawItem(
         text="", stream=True, max_tokens=rec.max_tokens,
@@ -1407,6 +1443,10 @@ async def handle_status(request: web.Request) -> web.Response:
         if reg is not None:
             dur["reconnect"] = reg.stats()
         body["durability"] = dur
+    jobs = getattr(batcher, "jobs", None)
+    if jobs is not None:
+        # Bulk inference lane (JOBS_ENABLED; docs/bulk-inference.md).
+        body["jobs"] = jobs.stats()
     tr = tracing.tracer()
     body["observability"] = {
         "trace": tr is not None,

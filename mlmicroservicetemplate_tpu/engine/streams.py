@@ -3696,14 +3696,32 @@ class ContinuousDecodeLoop:
             st.produced + ahead < st.budget for st in self.active.values()
         )
 
+    def interactive_load(self) -> tuple[bool, bool]:
+        """(interactive decode live, interactive work waiting) — the
+        class-pressure signal shared by the decode-window governor and
+        the bulk-job ``BackfillGovernor`` (scheduler/policy.py): live
+        means an interactive stream occupies a slot; waiting means one
+        sits in the deadline queue or mid-prefill/swap-in.  Safe to
+        read from the event loop (all plain reads)."""
+        from ..scheduler.policy import INTERACTIVE
+
+        live = any(
+            st.klass == INTERACTIVE and not st.cancelled.is_set()
+            for st in self.active.values()
+        )
+        waiting = self.queue.waiting(INTERACTIVE) > 0 or any(
+            j.st.klass == INTERACTIVE
+            for jobs in (self._prefilling, self._swapping)
+            for j in jobs
+        )
+        return live, waiting
+
     def _pick_window(self) -> int:
         """Fused-window depth for the NEXT dispatch: the governor's
         class policy, clamped to the chunks any live stream still
         needs beyond what is already in flight."""
         if self.decode_window <= 1 or self.spec:
             return 1
-        from ..scheduler.policy import INTERACTIVE
-
         chunk = self.engine.chunk_tokens
         ahead = self._inflight_chunks_ahead() * chunk
         need = max(
@@ -3714,15 +3732,7 @@ class ContinuousDecodeLoop:
             ),
             default=0,
         )
-        interactive_live = any(
-            st.klass == INTERACTIVE and not st.cancelled.is_set()
-            for st in self.active.values()
-        )
-        interactive_waiting = self.queue.waiting(INTERACTIVE) > 0 or any(
-            j.st.klass == INTERACTIVE
-            for jobs in (self._prefilling, self._swapping)
-            for j in jobs
-        )
+        interactive_live, interactive_waiting = self.interactive_load()
         return self._window_gov.pick(
             max_chunks=-(-need // chunk),
             interactive_live=interactive_live,

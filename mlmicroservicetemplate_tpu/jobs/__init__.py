@@ -1,0 +1,13 @@
+"""Bulk inference lane: durable ``/v1/batches`` jobs (JOBS_ENABLED).
+
+``store.py`` persists job manifests and per-line results through the
+write-ahead journal machinery (CRC-framed records under
+``JOURNAL_DIR/jobs``), ``executor.py`` feeds job lines into the fleet
+as batch-class idle backfill, ``api.py`` is the HTTP surface.  See
+docs/bulk-inference.md.
+"""
+
+from .executor import JobManager
+from .store import Job, JobStore
+
+__all__ = ["Job", "JobManager", "JobStore"]

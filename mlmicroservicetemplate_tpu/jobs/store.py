@@ -1,0 +1,382 @@
+"""Durable bulk-inference job state (the ``/v1/batches`` backbone).
+
+BatchGen (arXiv 2606.21712) makes durable job state the backbone of
+scalable batch inference: a bulk job is not a pile of HTTP requests but
+a MANIFEST — thousands of prompt lines — whose per-line progress
+outlives any single process.  This module is that backbone, built on
+the same write-ahead machinery as the stream journal
+(``runtime/durability.py``): every record is one JSON object framed by
+a ``<u32 length><u32 crc32>`` header in append-only segments under
+``JOURNAL_DIR/jobs``, torn tails truncate at replay, and open-time
+compaction keeps replay cost proportional to LIVE state.
+
+Record kinds:
+
+- ``job``    — the manifest: id, idempotency key, created time, and
+  every line's VALIDATED generation params (text, sampling fields
+  with the seed pinned at submit so re-runs are deterministic).
+  Written before the submit response goes out.
+- ``line``   — one completed line's result (text, token count, finish
+  reason, optional error).  Written BEFORE the in-memory state counts
+  the line complete (write-ahead), so a ``kill -9`` can lose at most
+  in-flight lines — which re-run to the same result — never recorded
+  ones.  Exactly-once: a duplicate ``line_done`` is refused in memory
+  and never appended.
+- ``state``  — job status transitions (queued → running → completed |
+  cancelled) with the terminal timestamp for TTL accounting.
+- ``purge``  — TTL tombstone: the job's records are skipped at the
+  next compaction.
+
+The store is process-local state the ``JobManager`` (executor.py)
+drives; one process owns the directory at a time — the parent
+``StreamJournal``'s flock on ``JOURNAL_DIR`` already guarantees that
+when the store lives in its standard location.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+
+from ..runtime.durability import append_frame, read_frames
+from ..utils import metrics
+
+log = logging.getLogger(__name__)
+
+QUEUED = "queued"
+RUNNING = "running"
+COMPLETED = "completed"
+CANCELLED = "cancelled"
+#: States a startup replay re-admits (anything non-terminal).
+ACTIVE_STATES = (QUEUED, RUNNING)
+
+_FSYNC_INTERVAL_S = 0.05
+#: Hard cap on lines per job — bounds one manifest record's size.
+MAX_LINES = 10_000
+
+
+class Job:
+    """One bulk job: the manifest plus per-line results."""
+
+    __slots__ = ("id", "key", "created", "lines", "results", "state",
+                 "done_at")
+
+    def __init__(self, jid: str, key: str | None, created: float,
+                 lines: list[dict]):
+        self.id = jid
+        self.key = key
+        self.created = float(created)
+        self.lines = lines
+        #: line index -> {"text", "tokens", "finish", ("error")}
+        self.results: dict[int, dict] = {}
+        self.state = QUEUED
+        self.done_at: float | None = None
+
+    @property
+    def total(self) -> int:
+        return len(self.lines)
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in (COMPLETED, CANCELLED)
+
+    def remaining(self) -> list[int]:
+        """Line indices with no recorded result — the resume work-list."""
+        return [i for i in range(self.total) if i not in self.results]
+
+    def counts(self) -> dict:
+        failed = sum(1 for r in self.results.values() if r.get("error"))
+        return {
+            "total": self.total,
+            "completed": len(self.results) - failed,
+            "failed": failed,
+        }
+
+    def to_json(self) -> dict:
+        """The API object shape (GET /v1/batches/{id})."""
+        body = {
+            "id": self.id,
+            "object": "batch",
+            "status": self.state,
+            "created_at": self.created,
+            "line_counts": self.counts(),
+        }
+        if self.key:
+            body["idempotency_key"] = self.key
+        if self.done_at is not None:
+            body["finished_at"] = self.done_at
+        return body
+
+
+class JobStore:
+    """Crash-safe job/line/result store (see module docstring).
+
+    Thread-safe like the stream journal: the executor appends line
+    results from event-loop callbacks while HTTP handlers read job
+    state; a lock keeps the in-memory maps and the append stream
+    coherent.
+    """
+
+    def __init__(self, dir: str, fsync: str = "always", model: str = "",
+                 ttl_s: float = 0.0):
+        self.dir = dir
+        self.fsync = str(fsync or "always").lower()
+        self.model = model or "unknown"
+        self.ttl_s = max(0.0, float(ttl_s or 0.0))
+        self._lock = threading.RLock()
+        self._last_fsync = 0.0
+        self.records_written = 0
+        self.torn_bytes = 0
+        self.jobs: dict[str, Job] = {}
+        self.by_key: dict[str, str] = {}
+        os.makedirs(dir, exist_ok=True)
+        segs = self._segments()
+        purged: set[str] = set()
+        for _, path in segs:
+            frames, good = read_frames(path)
+            sz = os.path.getsize(path)
+            if good < sz:
+                self.torn_bytes += sz - good
+                log.warning(
+                    "job store %s: torn tail (%d bytes) truncated at "
+                    "replay", path, sz - good,
+                )
+            for payload in frames:
+                try:
+                    self._apply(json.loads(payload), purged)
+                except Exception:
+                    log.exception("job store: unreadable record skipped")
+        # TTL expiry at open counts as a purge too.
+        now = time.time()
+        if self.ttl_s:
+            for job in self.jobs.values():
+                if job.terminal and job.done_at is not None and (
+                    now - job.done_at >= self.ttl_s
+                ):
+                    purged.add(job.id)
+        for jid in purged:
+            job = self.jobs.pop(jid, None)
+            if job is not None and job.key:
+                self.by_key.pop(job.key, None)
+        nxt = (segs[-1][0] + 1) if segs else 1
+        self._path = os.path.join(dir, f"jobs-{nxt:06d}.log")
+        self._f = open(self._path, "ab")
+        self._compact_into_open_segment()
+        for _, path in segs:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- replay --------------------------------------------------------
+
+    def _segments(self) -> list[tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("jobs-") and name.endswith(".log"):
+                try:
+                    out.append(
+                        (int(name[5:-4]), os.path.join(self.dir, name))
+                    )
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _apply(self, rec: dict, purged: set[str]) -> None:
+        k = rec.get("k")
+        jid = str(rec.get("id", ""))
+        if k == "job":
+            job = Job(
+                jid, rec.get("key") or None,
+                float(rec.get("created", 0.0)),
+                list(rec.get("lines", [])),
+            )
+            self.jobs[jid] = job
+            if job.key:
+                self.by_key[job.key] = jid
+            purged.discard(jid)
+        elif k == "line":
+            job = self.jobs.get(jid)
+            if job is not None:
+                i = int(rec.get("i", -1))
+                if 0 <= i < job.total:
+                    row = {
+                        "text": rec.get("text", ""),
+                        "tokens": int(rec.get("tokens", 0)),
+                        "finish": rec.get("finish", "stop"),
+                    }
+                    if rec.get("error"):
+                        row["error"] = str(rec["error"])
+                    job.results[i] = row
+        elif k == "state":
+            job = self.jobs.get(jid)
+            if job is not None:
+                job.state = str(rec.get("state", job.state))
+                if "t" in rec:
+                    job.done_at = float(rec["t"])
+        elif k == "purge":
+            purged.add(jid)
+
+    def _compact_into_open_segment(self) -> None:
+        with self._lock:
+            for job in self.jobs.values():
+                append_frame(self._f, (json.dumps({
+                    "k": "job", "id": job.id, "key": job.key,
+                    "created": job.created, "lines": job.lines,
+                }) + "\n").encode())
+                for i in sorted(job.results):
+                    r = job.results[i]
+                    append_frame(self._f, (json.dumps({
+                        "k": "line", "id": job.id, "i": i, **r,
+                    }) + "\n").encode())
+                if job.state != QUEUED:
+                    rec = {"k": "state", "id": job.id, "state": job.state}
+                    if job.done_at is not None:
+                        rec["t"] = job.done_at
+                    append_frame(self._f, (json.dumps(rec) + "\n").encode())
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    # -- appends (write-ahead) -----------------------------------------
+
+    def _append(self, rec: dict) -> None:
+        payload = (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+        with self._lock:
+            if self._f.closed:
+                return
+            append_frame(self._f, payload)
+            self._f.flush()
+            self.records_written += 1
+            now = time.monotonic()
+            if self.fsync == "always" or (
+                self.fsync == "interval"
+                and now - self._last_fsync >= _FSYNC_INTERVAL_S
+            ):
+                os.fsync(self._f.fileno())
+                self._last_fsync = now
+
+    # -- API -----------------------------------------------------------
+
+    def create(self, lines: list[dict],
+               key: str | None = None) -> tuple[Job, bool]:
+        """Persist one job manifest; returns ``(job, created)``.
+
+        ``created`` is False when ``key`` dedups onto an existing job —
+        the idempotency contract: a retried POST (client timeout, LB
+        replay) observes the FIRST submission instead of doubling the
+        work, exactly like unary X-Request-Id dedup."""
+        if not lines:
+            raise ValueError("a job needs at least one line")
+        if len(lines) > MAX_LINES:
+            raise ValueError(
+                f"{len(lines)} lines > MAX_LINES={MAX_LINES}"
+            )
+        with self._lock:
+            if key:
+                jid = self.by_key.get(key)
+                if jid is not None and jid in self.jobs:
+                    return self.jobs[jid], False
+            jid = "job-" + uuid.uuid4().hex[:16]
+            job = Job(jid, key, time.time(), lines)
+            self.jobs[jid] = job
+            if key:
+                self.by_key[key] = jid
+            self._append({
+                "k": "job", "id": jid, "key": key,
+                "created": job.created, "lines": lines,
+            })
+        return job, True
+
+    def line_done(self, jid: str, i: int, text: str, tokens: int,
+                  finish: str, error: str | None = None) -> bool:
+        """Record one line's result exactly once (write-ahead: the
+        append lands before the in-memory count moves).  False = the
+        line already had a result (duplicate refused, nothing written)."""
+        with self._lock:
+            job = self.jobs.get(jid)
+            if job is None or i in job.results:
+                return False
+            rec = {
+                "k": "line", "id": jid, "i": int(i), "text": text,
+                "tokens": int(tokens), "finish": finish,
+            }
+            if error:
+                rec["error"] = error
+            self._append(rec)
+            row = {"text": text, "tokens": int(tokens), "finish": finish}
+            if error:
+                row["error"] = error
+            job.results[int(i)] = row
+        metrics.JOB_LINES.labels(
+            self.model, "failed" if error else "completed"
+        ).inc()
+        return True
+
+    def set_state(self, jid: str, state: str) -> None:
+        with self._lock:
+            job = self.jobs.get(jid)
+            if job is None or job.state == state or job.terminal:
+                return
+            job.state = state
+            rec = {"k": "state", "id": jid, "state": state}
+            if state in (COMPLETED, CANCELLED):
+                job.done_at = time.time()
+                rec["t"] = job.done_at
+            self._append(rec)
+
+    def get(self, jid: str) -> Job | None:
+        with self._lock:
+            return self.jobs.get(jid)
+
+    def list(self) -> list[Job]:
+        with self._lock:
+            return sorted(self.jobs.values(), key=lambda j: j.created)
+
+    def sweep(self) -> int:
+        """Purge completed/cancelled jobs older than ``ttl_s`` (0 =
+        keep forever).  A ``purge`` tombstone makes the drop durable;
+        the next open-time compaction reclaims the bytes."""
+        if not self.ttl_s:
+            return 0
+        now = time.time()
+        dropped = 0
+        with self._lock:
+            for jid in list(self.jobs):
+                job = self.jobs[jid]
+                if job.terminal and job.done_at is not None and (
+                    now - job.done_at >= self.ttl_s
+                ):
+                    self._append({"k": "purge", "id": jid})
+                    del self.jobs[jid]
+                    if job.key:
+                        self.by_key.pop(job.key, None)
+                    dropped += 1
+        return dropped
+
+    def stats(self) -> dict:
+        with self._lock:
+            active = sum(
+                1 for j in self.jobs.values() if j.state in ACTIVE_STATES
+            )
+            return {
+                "dir": self.dir,
+                "jobs_tracked": len(self.jobs),
+                "jobs_active": active,
+                "records_written": self.records_written,
+                "torn_bytes_truncated": self.torn_bytes,
+                "result_ttl_s": self.ttl_s,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+                self._f.close()

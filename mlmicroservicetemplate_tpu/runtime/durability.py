@@ -65,6 +65,10 @@ _FSYNC_INTERVAL_S = 0.05
 # across restarts (reconnect idempotency) without unbounded growth.
 _KEEP_DONE = 256
 _KEEP_RESULTS = 1024
+# Completed-then-compacted rids kept as TOMBSTONES (rid + terminal
+# outcome only): the reconnect endpoint answers 410 "already finished,
+# history gone" for these, vs 404 for ids this journal never saw.
+_KEEP_TOMBS = 4096
 
 # The admission-record feats whitelist: everything a token-identical
 # resume needs, nothing engine-internal.  Deadlines are deliberately
@@ -174,6 +178,7 @@ class StreamJournal:
         # all-time history.
         self.streams: dict[str, RecoveredStream] = {}
         self.results: dict[str, list[int]] = {}
+        self.tombs: dict[str, str] = {}
         segs = self._segments()
         for _, path in segs:
             frames, good = read_frames(path)
@@ -226,6 +231,7 @@ class StreamJournal:
             # new segments both present) can never double-count deltas.
             rs.tokens = [int(t) for t in rec.get("delivered", [])]
             self.streams[rid] = rs
+            self.tombs.pop(rid, None)  # the rid lives again
         elif k == "tokens":
             rs = self.streams.get(rid)
             if rs is not None:
@@ -237,11 +243,18 @@ class StreamJournal:
                 rs.outcome = rec.get("outcome", "end")
         elif k == "result":
             self.results[rid] = [int(t) for t in rec.get("row", [])]
+        elif k == "tomb":
+            self.tombs[rid] = str(rec.get("outcome", "end"))
 
     def _compact_into_open_segment(self) -> None:
         done = [rs for rs in self.streams.values() if rs.done]
         for rs in done[: max(0, len(done) - _KEEP_DONE)]:
             self.streams.pop(rs.rid, None)
+            # The token history dies here; the terminal outcome
+            # survives as a tombstone so reconnects get 410, not 404.
+            self.tombs[rs.rid] = rs.outcome or "end"
+        for rid in list(self.tombs)[: max(0, len(self.tombs) - _KEEP_TOMBS)]:
+            self.tombs.pop(rid)
         for rid in list(self.results)[: max(0, len(self.results) - _KEEP_RESULTS)]:
             self.results.pop(rid, None)
         with self._lock:
@@ -260,6 +273,10 @@ class StreamJournal:
                 append_frame(self._f, (json.dumps({
                     "k": "result", "rid": rid, "row": row,
                 }) + "\n").encode())
+            for rid, outcome in self.tombs.items():
+                append_frame(self._f, (json.dumps({
+                    "k": "tomb", "rid": rid, "outcome": outcome,
+                }) + "\n").encode())
             self._f.flush()
             os.fsync(self._f.fileno())
 
@@ -270,6 +287,17 @@ class StreamJournal:
         with self._lock:
             row = self.results.get(rid)
             return list(row) if row is not None else None
+
+    def terminal_status(self, rid: str) -> str | None:
+        """The journaled terminal outcome for a COMPLETED stream —
+        live (still tracked) or compacted down to a tombstone.  None =
+        this journal never saw the rid finish (the reconnect endpoint's
+        404), else the outcome string behind its 410."""
+        with self._lock:
+            rs = self.streams.get(rid)
+            if rs is not None and rs.done:
+                return rs.outcome or "end"
+            return self.tombs.get(rid)
 
     # -- appends (write-ahead) -----------------------------------------
 
@@ -312,6 +340,7 @@ class StreamJournal:
                 rid, ser, klass, budget, stop=stop
             )
             rs.done = False
+            self.tombs.pop(rid, None)  # the rid lives again
         self._append("admit", {
             "k": "admit", "rid": rid, "feats": ser, "klass": klass,
             "budget": int(budget), "stop": list(stop),
@@ -367,6 +396,7 @@ class StreamJournal:
                 "streams_tracked": len(self.streams),
                 "streams_incomplete": inc,
                 "results_kept": len(self.results),
+                "tombstones": len(self.tombs),
                 "torn_bytes_truncated": self.torn_bytes,
             }
 

@@ -194,6 +194,22 @@ class AdmissionController:
                 self._note_downclass(feats, "kv_overcommit")
         return klass, kv
 
+    def backfill_ok(self) -> bool:
+        """Advisory pre-admission gate for bulk-job line claiming
+        (jobs/executor.py): False while draining or while the KV
+        ledger has no headroom at all, so the executor DEFERS the
+        claim instead of bouncing off ``admit`` as a metered shed —
+        backfill pressure must not pollute the shed counters the
+        operator alerts on."""
+        if self.draining:
+            return False
+        if self.paged and self.pool is not None:
+            return self.pool.free_blocks > 0
+        if self.kv_budget_bytes:
+            with self._lock:
+                return self._committed < self.kv_budget_bytes
+        return True
+
     def fits(self, item) -> bool:
         """Dequeue gate: may this waiter's KV reservation commit now?
 

@@ -197,6 +197,16 @@ class Batcher:
                 "FLEET_REPLICAS>1 requires CONTINUOUS_BATCHING=1 (the "
                 "fleet replicates the continuous decode loop)"
             )
+        # Bulk inference lane (JOBS_ENABLED; jobs/): the /v1/batches
+        # job subsystem — a durable JobStore under JOURNAL_DIR/jobs
+        # plus an executor that feeds job lines into THIS batcher as
+        # batch-class idle backfill.  None (default) = no job code
+        # anywhere on the serving path (pinned by test).
+        self.jobs = None
+        if getattr(cfg, "jobs_enabled", False):
+            from ..jobs.executor import JobManager
+
+            self.jobs = JobManager(engine, self, cfg)
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -205,6 +215,10 @@ class Batcher:
 
     async def stop(self) -> None:
         self._closed = True
+        if self.jobs is not None:
+            # Executor tasks first (they submit into this batcher),
+            # store closed with the journal below.
+            await self.jobs.stop()
         if self._task is not None:
             self._wake.set()
             await self._task
@@ -266,6 +280,20 @@ class Batcher:
         while self.pending_work() > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.05)
         return self.pending_work() == 0
+
+    def interactive_load(self) -> tuple[bool, bool]:
+        """(interactive decode live, interactive work waiting) across
+        the serving paths — the bulk-job backfill governor's claim
+        signal (scheduler/policy.BackfillGovernor)."""
+        if self.fleet is not None:
+            live = waiting = False
+            for rep in self.fleet.replicas:
+                l, w = rep.cdl.interactive_load()
+                live, waiting = live or l, waiting or w
+            return live, waiting
+        if self._cdl is not None:
+            return self._cdl.interactive_load()
+        return self._active_streams > 0, False
 
     # ------------------------------------------------------------------
     # shed helpers

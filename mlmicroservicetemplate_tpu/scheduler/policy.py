@@ -107,6 +107,39 @@ class PrefillPacer:
         return False
 
 
+class BackfillGovernor:
+    """How many bulk-job lines may ride in flight right now
+    (JOB_MAX_CONCURRENT_LINES; jobs/executor.py).
+
+    Bulk lines are batch-class streams, so the deadline queue's class
+    weights and chunk-boundary preemption already protect interactive
+    traffic once a line is ADMITTED — what this governor controls is
+    how hard the executor pushes on admission in the first place
+    (SLA-constrained batching, arXiv 2503.05248: the bulk lane rides
+    the same scheduler, it must not flood it):
+
+    - no interactive work anywhere → claim the full cap (pure
+      idle-compute backfill);
+    - interactive decode live → half the cap (lines in slots still
+      yield via preemption, but fresh claims deepen the next
+      preemption sweep);
+    - interactive work WAITING (queued or mid-prefill) → one line,
+      keeping the lane warm without competing for the very capacity
+      the waiters need.
+    """
+
+    def __init__(self, cap: int):
+        self.cap = max(1, int(cap))
+
+    def target(self, interactive_live: bool,
+               interactive_waiting: bool) -> int:
+        if interactive_waiting:
+            return 1
+        if interactive_live:
+            return max(1, self.cap // 2)
+        return self.cap
+
+
 class DecodeWindowGovernor:
     """Pick the fused decode-window depth W for one dispatch
     (DECODE_WINDOW; engine/streams.py, docs/decode-fusion.md).

@@ -141,6 +141,15 @@ def main(argv: list[str] | None = None) -> None:
             f"{cfg.kv_disk_budget_mb:g}MB"
             if cfg.kv_disk_budget_mb else "off",
         )
+    if cfg.jobs_enabled:
+        # Bulk inference lane: incomplete jobs re-admit from their last
+        # completed line at startup replay (api/app.py, after warmup).
+        log.info(
+            "bulk jobs: /v1/batches enabled (store=%s/jobs, "
+            "max_concurrent_lines=%d, result_ttl=%gs)",
+            cfg.journal_dir, cfg.job_max_concurrent_lines,
+            cfg.job_result_ttl_s,
+        )
     asyncio.run(_serve_until_signalled(app, cfg))
 
 

@@ -250,6 +250,24 @@ class ServiceConfig(BaseModel):
     # write through so their resume KV outlives the process.  0
     # (default) = no disk tier.
     kv_disk_budget_mb: float = 0.0
+    # Bulk inference lane (jobs/; docs/bulk-inference.md): the
+    # /v1/batches job API — thousands of JSONL prompt lines submitted
+    # as ONE durable job whose manifest, per-line state and results
+    # persist through the write-ahead journal machinery under
+    # JOURNAL_DIR/jobs, so a kill -9 mid-job resumes from the last
+    # completed line with exactly-once per-line results.  Lines run as
+    # batch-class streams behind the deadline queue and pacer — pure
+    # idle-compute backfill that interactive arrivals preempt at chunk
+    # boundaries.  Requires JOURNAL_DIR and a generative model.  Off
+    # (default) = no job code runs, serving paths bit-identical.
+    jobs_enabled: bool = False
+    # Per-job cap on lines in flight concurrently; the backfill
+    # governor throttles below it while interactive work is live or
+    # waiting (scheduler/policy.py).
+    job_max_concurrent_lines: int = 4
+    # Seconds a completed/cancelled job's results stay fetchable
+    # before the store purges them; 0 = keep forever.
+    job_result_ttl_s: float = 3600.0
     # Chunked prefill with prefill–decode interleaving
     # (docs/chunked-prefill.md): prompts longer than PREFILL_CHUNK
     # tokens prefill in PREFILL_CHUNK-token windows interleaved with
@@ -488,6 +506,20 @@ class ServiceConfig(BaseModel):
             )
         return v
 
+    @field_validator("job_max_concurrent_lines")
+    @classmethod
+    def _check_job_lines(cls, v: int) -> int:
+        if not (1 <= v <= 256):
+            raise ValueError("JOB_MAX_CONCURRENT_LINES must be in [1, 256]")
+        return v
+
+    @field_validator("job_result_ttl_s")
+    @classmethod
+    def _check_job_ttl(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("JOB_RESULT_TTL_S must be >= 0")
+        return v
+
     @field_validator("kv_prefetch_blocks")
     @classmethod
     def _check_kv_prefetch(cls, v: int) -> int:
@@ -591,7 +623,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       CLASS_WEIGHT, KV_BUDGET_MB, MAX_STREAM_QUEUE, PREEMPT,
       DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, KV_HOST_BUDGET_MB,
       KV_DISK_BUDGET_MB, JOURNAL_DIR, JOURNAL_FSYNC,
-      KV_PREFETCH_BLOCKS, PREFILL_CHUNK,
+      KV_PREFETCH_BLOCKS, JOBS_ENABLED, JOB_MAX_CONCURRENT_LINES,
+      JOB_RESULT_TTL_S, PREFILL_CHUNK,
       PREFILL_BUDGET, PREFILL_MAX_PROMPT, DECODE_WINDOW,
       DECODE_WINDOW_AUTO, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
@@ -650,6 +683,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "max_stream_queue": "MAX_STREAM_QUEUE",
         "kv_block_size": "KV_BLOCK_SIZE",
         "kv_prefetch_blocks": "KV_PREFETCH_BLOCKS",
+        "job_max_concurrent_lines": "JOB_MAX_CONCURRENT_LINES",
         "prefill_chunk": "PREFILL_CHUNK",
         "prefill_budget": "PREFILL_BUDGET",
         "prefill_max_prompt": "PREFILL_MAX_PROMPT",
@@ -677,6 +711,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("kv_budget_mb", "KV_BUDGET_MB"),
         ("kv_host_budget_mb", "KV_HOST_BUDGET_MB"),
         ("kv_disk_budget_mb", "KV_DISK_BUDGET_MB"),
+        ("job_result_ttl_s", "JOB_RESULT_TTL_S"),
         ("drain_grace_s", "DRAIN_GRACE_S"),
         ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
         ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),
@@ -695,6 +730,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PAGED_KV")
     if v is not None:
         kwargs["paged_kv"] = v.lower() not in ("0", "false", "no")
+    v = get("JOBS_ENABLED")
+    if v is not None:
+        kwargs["jobs_enabled"] = v.lower() not in ("0", "false", "no")
     v = get("SUPERVISE")
     if v is not None:
         kwargs["supervise"] = v.lower() not in ("0", "false", "no")

@@ -253,6 +253,26 @@ JOURNAL_REPLAY = Counter(
     "re-admit)",
     ["model", "outcome"],
 )
+JOBS_ACTIVE = Gauge(
+    "jobs_active",
+    "Bulk /v1/batches jobs with a live executor task (JOBS_ENABLED; "
+    "their lines backfill idle compute as batch-class streams)",
+    ["model"],
+)
+JOB_LINES = Counter(
+    "job_lines_total",
+    "Bulk job lines reaching a terminal state (completed = result "
+    "journaled write-ahead to JOURNAL_DIR/jobs, failed = the error "
+    "became the recorded result, cancelled = unfinished at job cancel)",
+    ["model", "state"],
+)
+JOB_REPLAYS = Counter(
+    "job_replays_total",
+    "Jobs processed at startup replay, by outcome (resumed = "
+    "re-admitted from the last completed line, complete = every line "
+    "finished before the kill, failed = could not re-admit)",
+    ["model", "outcome"],
+)
 KV_DISK_POOL_BLOCKS = Gauge(
     "kv_disk_pool_blocks",
     "Disk KV tier blocks by state (KV_DISK_BUDGET_MB; used = spilled "

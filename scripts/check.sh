@@ -134,6 +134,22 @@ else
     echo "== crash smoke skipped (CRASH_SMOKE=0) =="
 fi
 
+# Job smoke: a REAL serving process with JOBS_ENABLED=1 takes a
+# multi-line /v1/batches job, is SIGKILLed mid-job, restarts on the
+# same JOURNAL_DIR, and must complete the job with exactly-once
+# per-line results (no duplicates, no gaps; every line identical to
+# the interactive completion) while the stream journal drains to zero
+# incomplete streams (chaos tier, so it stays out of tier-1).
+# JOB_SMOKE=0 skips.
+if [ "${JOB_SMOKE:-1}" != "0" ]; then
+    echo "== job smoke (SIGKILL mid-job + store replay) =="
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_jobs.py::test_job_crash_smoke \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== job smoke skipped (JOB_SMOKE=0) =="
+fi
+
 # Observability smoke: the full HTTP service under TRACE=1 with a
 # transient fault injected, then /debug/trace (schema-valid Perfetto
 # JSON with every stage span) and /debug/engine (flight recorder with

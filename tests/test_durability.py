@@ -650,6 +650,92 @@ def test_reconnect_endpoint_serves_journal_plus_continuation(tmp_path):
     assert asyncio.run(body())
 
 
+def test_journal_tombstones_survive_compaction(tmp_path):
+    """Satellite (ISSUE 11): a done stream compacted out of the journal
+    leaves a TOMBSTONE — rid + terminal outcome — so ``terminal_status``
+    still answers after its token history is gone, across reopens."""
+    from mlmicroservicetemplate_tpu.runtime import durability as dur
+
+    d = str(tmp_path / "j")
+    j = StreamJournal(d, fsync="off", model="t")
+    n = dur._KEEP_DONE + 5
+    for i in range(n):
+        j.admit(f"d{i}", {"input_ids": [1], "length": 1}, "interactive", 2)
+        j.tokens(f"d{i}", [7])
+        j.done(f"d{i}", outcome="end")
+    assert j.terminal_status("d0") == "end"  # still tracked, done
+    assert j.terminal_status("never") is None
+    j.close()
+    # Reopen: compaction drops the oldest 5 done streams → tombstones.
+    j2 = StreamJournal(d, fsync="off", model="t")
+    assert "d0" not in j2.streams and j2.terminal_status("d0") == "end"
+    assert j2.stats()["tombstones"] >= 5
+    j2.close()
+    # A third open replays the tomb records themselves.
+    j3 = StreamJournal(d, fsync="off", model="t")
+    assert j3.terminal_status("d0") == "end"
+    assert j3.terminal_status("never") is None
+    # A rid that lives again (re-admitted) sheds its tombstone.
+    j3.admit("d0", {"input_ids": [1], "length": 1}, "interactive", 2)
+    assert j3.terminal_status("d0") is None
+    j3.close()
+
+
+def test_stream_attach_404_vs_410(tmp_path):
+    """Satellite (ISSUE 11): ``GET /v1/streams/{rid}`` distinguishes
+    "wrong id" from "already finished": never-seen rids 404; a
+    completed-then-compacted rid answers 410 with the journaled
+    terminal status, so reconnecting clients stop retrying."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mlmicroservicetemplate_tpu.api import build_app
+    from mlmicroservicetemplate_tpu.runtime import durability as dur
+    from mlmicroservicetemplate_tpu.scheduler import Batcher
+
+    bundle = tiny_gpt_bundle()
+    jd = str(tmp_path / "j")
+    cfg = _cfg(journal_dir=jd, journal_fsync="off", batch_timeout_ms=1.0)
+    # A previous life: enough done streams that compaction at the next
+    # open drops the oldest ones down to tombstones.
+    j = StreamJournal(jd, fsync="off", model=bundle.name)
+    for i in range(dur._KEEP_DONE + 3):
+        j.admit(f"g{i}", {"input_ids": [1], "length": 1}, "interactive", 2)
+        j.tokens(f"g{i}", [7])
+        j.done(f"g{i}", outcome="end")
+    j.close()
+
+    async def body():
+        eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(eng, cfg)
+        app = build_app(cfg, bundle, eng, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(200):
+                if (await client.get("/readyz")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            # Never-seen id: plain 404.
+            resp = await client.get("/v1/streams/never-seen-rid")
+            assert resp.status == 404
+            # Compacted-out done stream: 410 + the terminal status.
+            assert "g0" not in eng.journal.streams
+            resp = await client.get("/v1/streams/g0")
+            assert resp.status == 410, await resp.text()
+            body = await resp.json()
+            assert body["terminal"] == "end" and body["request_id"] == "g0"
+            # A done stream still tracked serves its body as before.
+            keep = f"g{dur._KEEP_DONE + 2}"
+            assert keep in eng.journal.streams
+            resp = await client.get(f"/v1/streams/{keep}")
+            assert resp.status == 200
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(body())
+
+
 # ---------------------------------------------------------------------------
 # chaos: real SIGKILL through a real server (scripts/check.sh CRASH_SMOKE)
 

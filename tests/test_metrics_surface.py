@@ -153,3 +153,64 @@ def test_every_declared_series_present_and_bounded():
 
     # The shed carried its reason label.
     assert 'requests_shed_total{model="gpt2",reason="drain"}' in text
+
+
+def test_job_series_present_after_bulk_smoke(tmp_path):
+    """Bulk-lane observability (ISSUE 11 satellite): one tiny job
+    through a JOBS_ENABLED app produces samples for the job series —
+    ``jobs_active`` (gauge, back to 0 at completion),
+    ``job_lines_total{state="completed"}`` counting every line, and the
+    ``job_replays_total`` family declared for the startup-replay path."""
+    if not metrics.HAVE_PROM:
+        pytest.skip("prometheus_client not installed")
+
+    async def main():
+        cfg = ServiceConfig(
+            device="cpu", warmup=False, batch_buckets=(1, 2, 4),
+            seq_buckets=(16, 32), max_decode_len=8,
+            stream_chunk_tokens=4, batch_timeout_ms=1.0, max_streams=2,
+            journal_dir=str(tmp_path / "j"), journal_fsync="off",
+            jobs_enabled=True, job_max_concurrent_lines=2,
+        )
+        bundle = tiny_gpt_bundle()
+        engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+        batcher = Batcher(engine, cfg)
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(200):
+                if (await client.get("/readyz")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            r = await client.post("/v1/batches", json={
+                "lines": [{"text": "metrics line a"},
+                          {"text": "metrics line b"}],
+            })
+            assert r.status == 201, await r.text()
+            jid = (await r.json())["id"]
+            import time
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                body = await (
+                    await client.get(f"/v1/batches/{jid}")
+                ).json()
+                if body["status"] == "completed":
+                    break
+                await asyncio.sleep(0.1)
+            assert body["status"] == "completed", body
+            r = await client.get("/metrics")
+            return await r.text()
+        finally:
+            await client.close()
+
+    text = asyncio.run(main())
+    for name in ("jobs_active", "job_lines_total", "job_replays_total"):
+        assert f"# HELP {name}" in text, f"{name} missing from /metrics"
+    assert 'jobs_active{model="gpt2"} 0.0' in text
+    line_samples = [
+        ln for ln in text.splitlines()
+        if ln.startswith('job_lines_total{model="gpt2",state="completed"}')
+    ]
+    assert line_samples and float(line_samples[0].rsplit(" ", 1)[1]) >= 2
