@@ -197,6 +197,16 @@ async def main() -> None:
             check=False,
         )
 
+    # Elastic autoscaling (round-17 tentpole): goodput + shed rate +
+    # scale-event latency under a burst→lull→burst arrival curve,
+    # static R=1 vs elastic [1..3] (donor-broadcast scale-up,
+    # drain-based scale-down).  SCALE_AB=0 skips.
+    if os.environ.get("SCALE_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "autoscale_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

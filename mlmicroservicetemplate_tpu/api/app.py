@@ -1287,6 +1287,18 @@ async def handle_readyz(request: web.Request) -> web.Response:
             if fleet.degraded:
                 body["degraded"] = True
                 headers["X-Fleet-Degraded"] = f"{healthy}/{fleet.n}"
+            if getattr(fleet, "elastic", False):
+                # Scale events are invisible to readiness (a spawning
+                # replica is not routable until probed; a draining one
+                # still finishes its streams) — but the LB operator can
+                # see them in flight here and in /status.fleet.scaling.
+                sc = fleet.scaling_status()
+                body["fleet"]["scaling"] = {
+                    "live": sc["live"], "min": sc["min"],
+                    "max": sc["max"],
+                    "in_progress": sc["in_progress"],
+                    "draining": sc["draining"],
+                }
             return web.json_response(body, headers=headers)
         body = {"ready": False}
         err = request.app[K_STATE]["ready_error"]

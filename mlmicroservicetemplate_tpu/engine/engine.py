@@ -48,7 +48,7 @@ class InferenceEngine:
     """Owns jitted executables + on-device params for one ModelBundle."""
 
     def __init__(self, bundle: ModelBundle, cfg, replicas: ReplicaSet | None = None,
-                 replica_id: int = 0):
+                 replica_id: int = 0, donor_params=None):
         import jax
 
         self.bundle = bundle
@@ -102,7 +102,21 @@ class InferenceEngine:
             self.replicas = bundle.make_placement()
         else:
             self.replicas = ReplicaSet(make_mesh(getattr(cfg, "replicas", 0)))
-        self.params = self.replicas.place_params(bundle.params)
+        # Param placement: the boot path uploads the bundle's host
+        # pytree once; fleet scale-ups pass ``donor_params`` — a live
+        # replica's already-placed device arrays — so a spawned engine
+        # pays a device-side broadcast (alias on the single-device
+        # fleet, ICI copy across devices) instead of a fresh host→HBM
+        # upload or a checkpoint reload (λScale; docs/autoscaling.md).
+        # ``params_source`` is the observability/test pin for that.
+        if donor_params is not None:
+            from ..runtime.distributed import broadcast_params
+
+            self.params = broadcast_params(donor_params, self.replicas)
+            self.params_source = "donor"
+        else:
+            self.params = self.replicas.place_params(bundle.params)
+            self.params_source = "host"
         self.batch_buckets = tuple(sorted(cfg.batch_buckets))
         self.seq_buckets = tuple(sorted(cfg.seq_buckets))
         # Decode budget rounded up to a whole number of stream chunks so

@@ -39,6 +39,33 @@ a replica crash costs latency, never output.
 
 ``FLEET_REPLICAS=1`` (default) never constructs this class: the
 single-replica path is bit-identical to the pre-fleet engine.
+
+Elastic scaling (docs/autoscaling.md): when ``FLEET_MIN/MAX_REPLICAS``
+open a range around ``FLEET_REPLICAS`` (which becomes the INITIAL
+size), a ``ScalingGovernor`` (scheduler/policy.py) ticks every
+``SCALE_PERIOD_S`` on the router's own load signals and drives
+``scale_to``:
+
+- **scale-UP** builds a fresh engine whose params broadcast from a
+  healthy donor replica's already-placed device arrays (λScale — no
+  checkpoint reload, no host re-upload; runtime/distributed.py is the
+  multi-device seam), warms its executables, and admits it to routing
+  only after a probe dispatch succeeds — a spawn that dies mid-build
+  never sheds existing traffic because it was never routable;
+- **scale-DOWN** drains the least-loaded replica inside
+  ``DRAIN_GRACE_S`` (streams finish in place) or evacuates the rest
+  through the r13 checkpoint machinery onto survivors,
+  token-identically, then retires it;
+- **rejoin**: a breaker-evicted replica is rebuilt through the same
+  spawn path once it has been dead ``FLEET_EVICT_S`` — eviction makes
+  a hole the governor repairs, not a permanent capacity loss;
+- every event **rebalances** the fleet KV budget across the LIVE
+  replicas (``AdmissionController.set_budget``), so a corpse's share
+  returns to the survivors instead of stranding.
+
+``FLEET_MAX_REPLICAS`` unset (or equal to ``FLEET_REPLICAS`` with
+``FLEET_MIN`` too) keeps the fleet static: no governor object, no
+scaler thread, bit-identical to the pre-elastic code.
 """
 
 from __future__ import annotations
@@ -46,6 +73,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
+
+import numpy as np
 
 from ..utils import metrics
 
@@ -169,10 +199,15 @@ class Replica:
         self.breaker = breaker
         self.dead = False
         self.dead_cause: str | None = None
+        self.dead_at: float | None = None  # rejoin clock (fleet clock)
+        # Scale-down in progress: the router skips a draining replica
+        # (no new work) while its loop finishes what it holds.
+        self.draining = False
 
     def healthy(self) -> bool:
         return (
             not self.dead
+            and not self.draining
             and not self.cdl.dead
             and not self.supervisor.failed
             and not self.cdl._stop.is_set()
@@ -193,12 +228,9 @@ class Replica:
 class ReplicaFleet:
     """The fleet: construction, routing, health sweeps, failover."""
 
-    def __init__(self, engine, cfg, clock=None):
-        from ..scheduler.admission import AdmissionController
+    def __init__(self, engine, cfg, clock=None, autoscale_thread=True):
         from ..scheduler.router import Router
         from .engine import InferenceEngine
-        from .streams import ContinuousDecodeLoop
-        from .supervisor import Supervisor
 
         if getattr(cfg, "spec_continuous", False):
             raise ValueError(
@@ -221,21 +253,38 @@ class ReplicaFleet:
         self.cfg = cfg
         self.model = engine.bundle.name
         self.n = max(1, int(getattr(cfg, "fleet_replicas", 1)))
+        self._initial_n = self.n  # FLEET_REPLICAS; self.n tracks live+dead
+        # Elastic bounds (docs/autoscaling.md): FLEET_REPLICAS is the
+        # INITIAL size; 0 bounds collapse onto it (static fleet).
+        self.min_r = int(getattr(cfg, "fleet_min_replicas", 0) or 0) or self.n
+        self.max_r = int(getattr(cfg, "fleet_max_replicas", 0) or 0) or self.n
+        self.elastic = self.min_r != self.n or self.max_r != self.n
         self.evict_s = float(getattr(cfg, "fleet_evict_s", 10.0) or 0.0)
-        breaker_n = int(getattr(cfg, "fleet_breaker_n", 3) or 3)
+        self._breaker_n = int(getattr(cfg, "fleet_breaker_n", 3) or 3)
         self.router = Router(getattr(cfg, "fleet_route", "least"))
         self._clock = clock if clock is not None else time.monotonic
+        self._breaker_clock = clock
         self._lock = threading.Lock()
+        # Scale events serialize on their own lock: a scale-down WAITS
+        # on a draining loop whose evacuation callback takes ``_lock``
+        # — holding ``_lock`` across the wait would deadlock.
+        self._scale_lock = threading.Lock()
         self.failovers = 0
+        self.scale_period_s = float(
+            getattr(cfg, "scale_period_s", 0.5) or 0.5
+        )
+        # Streams on the Batcher's legacy per-stream path count against
+        # every replica's MAX_STREAMS bound; the Batcher re-points this
+        # at its own counter (spawned replicas inherit it through the
+        # indirection in _wire_replica).
+        self.external_active = lambda: 0
 
         # One fleet budget → per-replica pool-authoritative ledgers:
-        # each replica admits against its own share.
-        budget = float(getattr(cfg, "kv_budget_mb", 0.0) or 0.0)
-        split = self.n > 1 and budget > 0
-        per_cfg = (
-            cfg.model_copy(update={"kv_budget_mb": budget / self.n})
-            if split else cfg
-        )
+        # each replica admits against its own share of the LIVE split.
+        self.budget_mb = float(getattr(cfg, "kv_budget_mb", 0.0) or 0.0)
+        self.budget_bytes = int(self.budget_mb * 1e6)
+        per_cfg = self._share_cfg(self.n)
+        split = per_cfg is not cfg
 
         self.replicas: list[Replica] = []
         for r in range(self.n):
@@ -245,62 +294,132 @@ class ReplicaFleet:
                 # in which case it is rebuilt at the per-replica share.
                 eng = engine
             else:
+                # Boot replicas 1..R-1 broadcast params from replica
+                # 0's already-placed arrays — same λScale path live
+                # scale-ups use, so boot pays ONE host→device upload
+                # total instead of R.
                 eng = InferenceEngine(
                     engine.bundle, per_cfg, replicas=engine.replicas,
-                    replica_id=r,
+                    replica_id=r, donor_params=engine.params,
                 )
-            cdl = ContinuousDecodeLoop(eng, per_cfg)
-            sup = Supervisor(per_cfg, recorder=eng.flight)
-            cdl.supervisor = sup
-            adm = AdmissionController(per_cfg, eng)
-            cdl.admission = adm
-            breaker = CircuitBreaker(breaker_n, self.evict_s, clock=clock)
-            rep = Replica(r, eng, cdl, sup, adm, breaker)
-            cdl.failover = self._failover_cb(rep)
-            cdl.on_fault = self._on_fault_cb(rep)
-            cdl.on_ok = breaker.record_ok
-            self.replicas.append(rep)
-        # ONE host KV tier for the whole fleet (KV_HOST_BUDGET_MB;
-        # docs/kv-tiering.md): host copies are replica-agnostic (same
-        # params produce the same KV), so a failed-over stream
-        # swap-resumes on its adopter and a demoted prefix serves every
-        # replica — the fleet-scale host-backed cache.
-        shared_tier = getattr(self.replicas[0].engine, "kv_host", None)
-        if shared_tier is not None:
-            for rep in self.replicas[1:]:
-                rep.engine.kv_host = shared_tier
-        # ONE stream journal and ONE disk KV tier for the whole fleet
-        # (runtime/durability.py): the journal is keyed by request id —
-        # replica-agnostic by construction, so an adopter's loop keeps
-        # appending the dead replica's stream cursors — and the disk
-        # tier persists under one JOURNAL_DIR.  The base engine carries
-        # both (the Batcher attaches the journal before building the
-        # fleet; only replica 0 constructs a disk tier).
-        shared_journal = getattr(engine, "journal", None)
-        shared_disk = getattr(engine, "kv_disk", None) or getattr(
+            self.replicas.append(self._wire_replica(eng, per_cfg))
+        # ONE host KV tier, ONE stream journal and ONE disk KV tier for
+        # the whole fleet (docs/kv-tiering.md, runtime/durability.py):
+        # host KV copies and journal records are replica-agnostic, so a
+        # failed-over stream swap-resumes on its adopter and a demoted
+        # prefix serves every replica.  The base engine carries the
+        # journal (the Batcher attaches it before building the fleet);
+        # only a replica-0 engine constructs a disk tier.
+        self._shared_journal = getattr(engine, "journal", None)
+        self._shared_disk = getattr(engine, "kv_disk", None) or getattr(
             self.replicas[0].engine, "kv_disk", None
         )
+        self._shared_host = getattr(self.replicas[0].engine, "kv_host", None)
         for rep in self.replicas:
-            if getattr(rep.engine, "journal", None) is None:
-                rep.engine.journal = shared_journal
-            old = getattr(rep.engine, "kv_disk", None)
-            if old is not None and old is not shared_disk:
-                # A rebuilt replica-0 engine (split-budget pool) built
-                # its own tier on the SAME directory — two index
-                # handles would corrupt each other; the base's wins.
-                old.close()
-            rep.engine.kv_disk = shared_disk
+            self._share_tiers(rep)
+        # Elastic scaling state: the governor decides, scale_tick acts.
+        self._next_id = self.n
+        self._spawning: dict | None = None
+        self._scale_events: deque = deque(maxlen=64)
+        self._scale_counts: dict[str, int] = {}
+        self._last_scale_duration_s: float | None = None
+        self.governor = None
+        self._scaler_thread: threading.Thread | None = None
+        self._scaler_stop = threading.Event()
+        if self.elastic:
+            from ..scheduler.policy import ScalingGovernor
+
+            self.governor = ScalingGovernor(
+                self.min_r, self.max_r,
+                up_queue=float(getattr(cfg, "scale_up_queue", 2.0)),
+                up_kv_frac=float(getattr(cfg, "scale_up_kv_frac", 0.85)),
+                up_ttft_s=float(
+                    getattr(cfg, "scale_up_ttft_ms", 0.0) or 0.0
+                ) / 1e3,
+                up_cooldown_s=float(
+                    getattr(cfg, "scale_up_cooldown_s", 3.0)
+                ),
+                down_load=float(getattr(cfg, "scale_down_load", 0.25)),
+                down_cooldown_s=float(
+                    getattr(cfg, "scale_down_cooldown_s", 10.0)
+                ),
+                clock=clock,
+            )
+            self._rebalance()
+            if autoscale_thread:
+                self._scaler_thread = threading.Thread(
+                    target=self._scaler_run, name="fleet-scaler",
+                    daemon=True,
+                )
+                self._scaler_thread.start()
         self._refresh_gauges()
         log.info(
-            "replica fleet up: %d replicas, route=%s, breaker_n=%d, "
-            "evict_s=%.1f", self.n, self.router.policy, breaker_n,
-            self.evict_s,
+            "replica fleet up: %d replicas%s, route=%s, breaker_n=%d, "
+            "evict_s=%.1f", self.n,
+            f" (elastic [{self.min_r}, {self.max_r}], "
+            f"period={self.scale_period_s:g}s)" if self.elastic else "",
+            self.router.policy, self._breaker_n, self.evict_s,
         )
+
+    # -- construction helpers (boot + live scale-up) -------------------
+
+    def _share_cfg(self, live_count: int):
+        """Per-replica config at a ``live_count``-way budget split (the
+        whole config when no budget is set or the fleet is one wide)."""
+        if self.budget_bytes and live_count > 1:
+            return self.cfg.model_copy(
+                update={"kv_budget_mb": self.budget_mb / live_count}
+            )
+        return self.cfg
+
+    def _wire_replica(self, eng, per_cfg) -> Replica:
+        """Loop + supervisor + admission + breaker around one engine —
+        the same wiring for boot replicas and live spawns."""
+        from ..scheduler.admission import AdmissionController
+        from .streams import ContinuousDecodeLoop
+        from .supervisor import Supervisor
+
+        cdl = ContinuousDecodeLoop(eng, per_cfg)
+        sup = Supervisor(per_cfg, recorder=eng.flight)
+        cdl.supervisor = sup
+        adm = AdmissionController(per_cfg, eng)
+        cdl.admission = adm
+        breaker = CircuitBreaker(
+            self._breaker_n, self.evict_s, clock=self._breaker_clock
+        )
+        rep = Replica(int(eng.replica_id), eng, cdl, sup, adm, breaker)
+        cdl.failover = self._failover_cb(rep)
+        cdl.on_fault = self._on_fault_cb(rep)
+        cdl.on_ok = breaker.record_ok
+        cdl.external_active = lambda: self.external_active()
+        return rep
+
+    def _share_tiers(self, rep: Replica) -> None:
+        """Point one replica's engine at the fleet-shared host tier,
+        journal and disk tier."""
+        if self._shared_host is not None:
+            rep.engine.kv_host = self._shared_host
+        if getattr(rep.engine, "journal", None) is None:
+            rep.engine.journal = self._shared_journal
+        old = getattr(rep.engine, "kv_disk", None)
+        if old is not None and old is not self._shared_disk:
+            # A rebuilt replica-0 engine (split-budget pool) built its
+            # own tier on the SAME directory — two index handles would
+            # corrupt each other; the base's wins.
+            old.close()
+        rep.engine.kv_disk = self._shared_disk
 
     # -- health --------------------------------------------------------
 
     def healthy_replicas(self) -> list[Replica]:
         return [r for r in self.replicas if r.healthy()]
+
+    def live_replicas(self) -> list[Replica]:
+        """Replicas that count toward capacity (not dead, not on their
+        way out) — the budget-split denominator and the governor's
+        ``live`` signal.  A breaker-open replica is still LIVE (its
+        supervisor is churning restarts; routing just avoids it)."""
+        return [r for r in self.replicas if not r.dead and not r.draining]
 
     @property
     def degraded(self) -> bool:
@@ -308,22 +427,28 @@ class ReplicaFleet:
         capacity — batch-class sheds first, /readyz stamps
         X-Fleet-Degraded."""
         dead = sum(1 for r in self.replicas if r.dead)
-        return 0 < dead < self.n
+        return 0 < dead < len(self.replicas)
 
     @property
     def all_dead(self) -> bool:
         return not self.healthy_replicas()
 
     def retry_after_s(self) -> float:
-        """Retry-After guidance for an all-dead fleet: the nearest
-        breaker half-open ETA (plus any supervisor window slot that
-        frees sooner)."""
+        """Retry-After guidance for an all-dead fleet: the SOONER of
+        the nearest breaker half-open ETA (plus any supervisor window
+        slot that frees earlier) and — under elastic scaling — the
+        governor's replacement spin-up ETA: a dead replica rebuilds
+        ``FLEET_EVICT_S`` after its death, within one governor period
+        (docs/autoscaling.md)."""
         etas = []
         for r in self.replicas:
             etas.append(r.breaker.retry_eta_s())
             w = r.supervisor.retry_eta_s()
             if w > 0:
                 etas.append(w)
+            if self.elastic and r.dead and r.dead_at is not None:
+                rejoin = max(0.0, r.dead_at + self.evict_s - self._clock())
+                etas.append(rejoin + self.scale_period_s)
         positive = [e for e in etas if e > 0]
         return max(1.0, min(positive)) if positive else 1.0
 
@@ -348,10 +473,22 @@ class ReplicaFleet:
         self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
+        live = draining = evicted = 0
         for rep in self.replicas:
             metrics.FLEET_BREAKER.labels(self.model, str(rep.id)).set(
                 DEAD if rep.dead else rep.breaker.state
             )
+            if rep.dead:
+                evicted += 1
+            elif rep.draining:
+                draining += 1
+            else:
+                live += 1
+        for state, count in (
+            ("live", live), ("draining", draining), ("evicted", evicted),
+            ("spawning", 1 if self._spawning is not None else 0),
+        ):
+            metrics.FLEET_REPLICAS.labels(self.model, state).set(count)
 
     # -- routing -------------------------------------------------------
 
@@ -433,7 +570,12 @@ class ReplicaFleet:
     def _mark_dead(self, rep: Replica, cause: str) -> None:
         rep.dead = True
         rep.dead_cause = cause
+        rep.dead_at = self._clock()  # the rejoin clock starts here
         rep.breaker.mark_dead()
+        # A corpse's KV-budget share returns to the survivors instead
+        # of stranding with it (elastic fleets only — static split
+        # semantics stay bit-identical).
+        self._rebalance()
 
     def _failover_cb(self, rep: Replica):
         """The callback ``streams._evacuate`` invokes with the dead
@@ -476,6 +618,304 @@ class ReplicaFleet:
 
         return failover
 
+    # -- elastic scaling (docs/autoscaling.md) -------------------------
+
+    def _rebalance(self) -> None:
+        """Re-split the fleet KV budget across the LIVE replicas.
+        Elastic fleets only — the static boot split is physical (each
+        pool sized at budget/R) and must stay bit-identical."""
+        if not self.elastic or not self.budget_bytes:
+            return
+        live = self.live_replicas()
+        if not live:
+            return
+        share = self.budget_bytes // len(live)
+        for rep in live:
+            rep.admission.set_budget(share)
+
+    def _record_scale(self, direction: str, cause: str, rid: int,
+                      t0: float) -> None:
+        dt = time.monotonic() - t0
+        self._last_scale_duration_s = dt
+        metrics.FLEET_SCALE_EVENTS.labels(self.model, direction, cause).inc()
+        metrics.FLEET_SCALE_DURATION.labels(self.model, direction).observe(dt)
+        key = f"{direction}:{cause}"
+        self._scale_counts[key] = self._scale_counts.get(key, 0) + 1
+        self._scale_events.append({
+            "dir": direction, "cause": cause, "replica": rid,
+            "duration_s": round(dt, 3),
+        })
+
+    def _probe(self, rep: Replica) -> None:
+        """One real dispatch through the spawned engine BEFORE it joins
+        routing: collate a minimal prompt, run the fused start and
+        fetch the tokens under the dispatch guard (site ``chunk``, so a
+        replica-scoped chaos schedule can kill the spawn here).  Raises
+        on any fault — the caller discards the replica."""
+        import jax
+
+        eng = rep.engine
+        s = min(eng.seq_buckets)
+        feats = {"input_ids": np.ones(s, np.int32), "length": np.int32(s)}
+
+        def go():
+            with eng._lock:
+                ids, mask, _ = eng._collate_text([feats])
+                sp, _ = eng._collate_sample([feats], ids.shape[0])
+                ids, mask = eng.replicas.place_batch(ids, mask)
+                _state, toks = eng._start(
+                    eng.params, ids, mask, sp,
+                    eng.max_decode_len, eng.chunk_tokens, False,
+                )
+                return jax.device_get(toks)
+
+        eng.dispatch_guard("chunk", go)
+        rep.breaker.record_ok()
+
+    def _spawn_replica(self, cause: str, reuse_id: int | None = None,
+                       replace: Replica | None = None) -> Replica | None:
+        """Build, warm and probe one new replica; admit it to routing
+        only on success.  Params broadcast from a live donor's placed
+        arrays (λScale) — never a checkpoint reload, never a fresh
+        host upload while any replica holds the params.  Returns the
+        admitted Replica, or None when the spawn failed (existing
+        traffic is untouched either way: the spawn was never
+        routable)."""
+        from .engine import InferenceEngine
+
+        t0 = time.monotonic()
+        donor = next((r for r in self.replicas if r.healthy()), None)
+        donor_eng = donor.engine if donor is not None \
+            else self.replicas[0].engine
+        rid = reuse_id if reuse_id is not None else self._next_id
+        per_cfg = self._share_cfg(len(self.live_replicas()) + 1)
+        self._spawning = {"replica": rid, "cause": cause}
+        self._refresh_gauges()
+        try:
+            eng = InferenceEngine(
+                donor_eng.bundle, per_cfg, replicas=donor_eng.replicas,
+                replica_id=rid, donor_params=donor_eng.params,
+            )
+            rep = self._wire_replica(eng, per_cfg)
+            self._share_tiers(rep)
+            rep.cdl.warm()
+            self._probe(rep)
+        except Exception as e:
+            # A mid-scale-up death (probe fault, OOM at warm) aborts
+            # JUST the spawn: nothing was routed here yet, so existing
+            # traffic never sheds.  The governor retries next tick.
+            log.warning(
+                "scale-up spawn failed (replica %d, cause=%s): %s: %s",
+                rid, cause, type(e).__name__, e,
+            )
+            self._spawning = None
+            self._record_scale("up", "spawn_failed", rid, t0)
+            self._refresh_gauges()
+            return None
+        self._spawning = None
+        with self._lock:
+            if replace is not None and replace in self.replicas:
+                # Rejoin: the rebuilt replica takes the corpse's seat
+                # (and id — bounded metric labels, restored KV share).
+                self.replicas = [
+                    rep if r is replace else r for r in self.replicas
+                ]
+            else:
+                self.replicas = self.replicas + [rep]
+            self.n = len(self.replicas)
+            if reuse_id is None:
+                self._next_id = max(self._next_id, rid + 1)
+        self._rebalance()
+        self._record_scale("up", cause, rid, t0)
+        self._refresh_gauges()
+        log.info(
+            "scale-up: replica %d admitted (cause=%s, params=%s, "
+            "%.2fs) — fleet now %d live", rid, cause,
+            rep.engine.params_source, time.monotonic() - t0,
+            len(self.live_replicas()),
+        )
+        return rep
+
+    def _scale_down(self, cause: str) -> Replica | None:
+        """Retire the least-loaded live replica: drain it inside
+        DRAIN_GRACE_S (streams finish in place, token-identically), or
+        evacuate the stragglers through the r13 checkpoint machinery
+        onto the survivors.  Replica id 0 is never retired — its engine
+        anchors the shared journal/tier objects and the Batcher's
+        introspection.  Returns the retired Replica or None."""
+        from ..scheduler.router import replica_load
+
+        live = self.live_replicas()
+        floor = max(1, self.min_r if self.elastic else 1)
+        candidates = [r for r in live if r.id != 0]
+        if len(live) <= floor or not candidates:
+            return None
+        rep = min(candidates, key=replica_load)
+        t0 = time.monotonic()
+        rep.draining = True
+        self._refresh_gauges()
+        grace = float(getattr(self.cfg, "drain_grace_s", 30.0) or 0.0)
+        deadline = t0 + grace
+        thread = rep.cdl._thread
+        started = thread is not None and thread.is_alive()
+        while started and time.monotonic() < deadline:
+            if rep.cdl.idle():
+                break
+            time.sleep(0.02)
+        if not started or rep.cdl.idle():
+            # Clean drain: nothing held, the loop just stops.
+            rep.cdl.stop()
+            with self._lock:
+                rep.dead = True
+                rep.dead_cause = cause
+                rep.breaker.mark_dead()
+        else:
+            # Grace expired with streams still live: checkpoint-and-
+            # adopt them onto the survivors (token-identical — the r13
+            # failover core), then the loop stops itself.
+            rep.cdl.request_evacuation("scale_down")
+            t = rep.cdl._thread
+            if t is not None:
+                t.join(timeout=grace + 5.0)
+        self._retire(rep, cause, t0)
+        return rep
+
+    def _retire(self, rep: Replica, cause: str, t0: float) -> None:
+        with self._lock:
+            self.replicas = [r for r in self.replicas if r is not rep]
+            self.n = len(self.replicas)
+        self._rebalance()
+        self._record_scale("down", cause, rep.id, t0)
+        self._refresh_gauges()
+        pool = getattr(rep.engine, "kv_pool", None)
+        log.info(
+            "scale-down: replica %d retired (cause=%s, %.2fs, pool "
+            "used=%s) — fleet now %d live", rep.id, cause,
+            time.monotonic() - t0,
+            pool.used_blocks if pool is not None else "n/a",
+            len(self.live_replicas()),
+        )
+
+    def _maybe_rejoin(self) -> None:
+        """Rebuild breaker-evicted / budget-spent replicas through the
+        spawn path once they have been dead FLEET_EVICT_S — eviction
+        opens a hole the governor repairs, not a permanent loss."""
+        if not self.elastic:
+            return
+        now = self._clock()
+        for rep in list(self.replicas):
+            if not rep.dead or rep.dead_at is None:
+                continue
+            if now - rep.dead_at < self.evict_s:
+                continue
+            if len(self.live_replicas()) >= self.max_r:
+                break
+            if self._spawn_replica("rejoin", reuse_id=rep.id,
+                                   replace=rep) is None:
+                break  # retry next tick
+
+    def _load_snapshot(self) -> dict:
+        """The governor's inputs, from the router's own load signals:
+        queue depths, slot occupancy, committed-KV fraction of the live
+        budget, and the decode loops' TTFT EWMA."""
+        live = self.live_replicas()
+        queued = sum(r.cdl.queue.qsize() for r in live)
+        active = sum(
+            len(r.cdl.active) + len(r.cdl._prefilling)
+            + len(r.cdl._swapping)
+            for r in live
+        )
+        slots = max((r.cdl.max_streams for r in live), default=1)
+        kv_frac = 0.0
+        if self.budget_bytes:
+            kv_frac = sum(
+                r.admission.committed_bytes for r in live
+            ) / self.budget_bytes
+        elif live and live[0].admission.paged \
+                and live[0].admission.pool is not None:
+            total = sum(r.admission.ledger_blocks() for r in live)
+            used = sum(r.admission.pool.used_blocks for r in live)
+            kv_frac = used / total if total else 0.0
+        ttft = max((r.cdl.ttft_ewma_s for r in live), default=0.0)
+        return {
+            "live": len(live), "queued": queued, "active": active,
+            "slots": slots, "kv_frac": kv_frac, "ttft_ewma_s": ttft,
+        }
+
+    def scale_tick(self) -> None:
+        """One governor period: sweep breaker evictions, rebuild
+        rejoin-due corpses, then act on the governor's load decision.
+        The scaler thread calls this every SCALE_PERIOD_S; tests and
+        benchmarks may call it directly."""
+        if not self.elastic:
+            return
+        if self.draining:
+            # SIGTERM drain in progress: the fleet is winding down —
+            # spawning would waste the grace window and retiring would
+            # race the drain's own quiescence wait.
+            return
+        with self._scale_lock:
+            self.sweep()
+            self._maybe_rejoin()
+            snap = self._load_snapshot()
+            direction, cause = self.governor.decide(**snap)
+            if direction == "up":
+                if self._spawn_replica(cause) is not None:
+                    self.governor.note_event("up")
+            elif direction == "down":
+                if self._scale_down(cause) is not None:
+                    self.governor.note_event("down")
+
+    def scale_to(self, target: int, cause: str = "manual") -> int:
+        """Drive the live replica count to ``target`` (clamped to the
+        elastic bounds when elastic).  Returns the live count."""
+        if self.elastic:
+            target = max(self.min_r, min(int(target), self.max_r))
+        else:
+            target = max(1, int(target))
+        with self._scale_lock:
+            while len(self.live_replicas()) < target:
+                if self._spawn_replica(cause) is None:
+                    break
+            while len(self.live_replicas()) > target:
+                if self._scale_down(cause) is None:
+                    break
+        return len(self.live_replicas())
+
+    def _scaler_run(self) -> None:
+        while not self._scaler_stop.wait(self.scale_period_s):
+            try:
+                self.scale_tick()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("fleet scale tick failed")
+
+    def scaling_status(self) -> dict:
+        """/status.fleet.scaling: bounds, live count, governor clocks,
+        recent events — the operator view of why the fleet is (not)
+        moving."""
+        out = {
+            "elastic": self.elastic,
+            "initial": self._initial_n,
+            "min": self.min_r,
+            "max": self.max_r,
+            "live": len(self.live_replicas()),
+            "in_progress": self._spawning,
+            "draining": [r.id for r in self.replicas if r.draining],
+            "last_duration_s": (
+                round(self._last_scale_duration_s, 3)
+                if self._last_scale_duration_s is not None else None
+            ),
+            "events": self._scale_counts,
+            "recent": list(self._scale_events)[-8:],
+        }
+        if self.governor is not None:
+            out["governor"] = self.governor.status()
+            out["signals"] = {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self._load_snapshot().items()
+            }
+        return out
+
     # -- lifecycle -----------------------------------------------------
 
     def warm(self) -> None:
@@ -500,6 +940,10 @@ class ReplicaFleet:
         )
 
     def stop(self) -> None:
+        if self._scaler_thread is not None:
+            self._scaler_stop.set()
+            self._scaler_thread.join(timeout=10)
+            self._scaler_thread = None
         for rep in self.replicas:
             rep.cdl.stop()
 
@@ -515,10 +959,12 @@ class ReplicaFleet:
             "dead": sum(1 for r in self.replicas if r.dead),
             "degraded": self.degraded,
             "failovers": self.failovers,
+            "scaling": self.scaling_status(),
             "per_replica": [
                 {
                     "id": r.id,
                     "healthy": r.healthy(),
+                    "draining": r.draining,
                     "breaker": (
                         "dead" if r.dead else r.breaker.state_name
                     ),

@@ -446,6 +446,11 @@ class ContinuousDecodeLoop:
         self.preempt = bool(getattr(cfg, "preempt", True))
         self.preemptions = 0  # observability + test hook
         self._stream_ewma_s = 1.0
+        # Latency EWMAs (scheduler/policy.ScalingGovernor signals, also
+        # /status.fleet.scaling): time-to-first-chunk and inter-chunk
+        # cadence, updated at delivery.  0.0 until the first sample.
+        self.ttft_ewma_s = 0.0
+        self.tbt_ewma_s = 0.0
         self.active: dict[int, _Stream] = {}
         self.sampled_slots: set[int] = set()
         self.free: list[int] = list(range(self.n_slots))
@@ -1650,8 +1655,17 @@ class ContinuousDecodeLoop:
             # is TTFT's business, not TBT's.
             now = time.monotonic()
             if st.t_emit:
-                metrics.TBT.labels(self.engine.bundle.name).observe(
-                    now - st.t_emit
+                gap = now - st.t_emit
+                metrics.TBT.labels(self.engine.bundle.name).observe(gap)
+                self.tbt_ewma_s = (
+                    gap if not self.tbt_ewma_s
+                    else 0.8 * self.tbt_ewma_s + 0.2 * gap
+                )
+            else:
+                ttft = now - st.t_in
+                self.ttft_ewma_s = (
+                    ttft if not self.ttft_ewma_s
+                    else 0.8 * self.ttft_ewma_s + 0.2 * ttft
                 )
             st.t_emit = now
 
@@ -3694,6 +3708,22 @@ class ContinuousDecodeLoop:
         ahead = self._inflight_chunks_ahead() * self.engine.chunk_tokens
         return any(
             st.produced + ahead < st.budget for st in self.active.values()
+        )
+
+    def idle(self) -> bool:
+        """True when NOTHING is admitted, queued, prefilling, swapping
+        or in flight — the quiescence gate a drain-based fleet
+        scale-down waits on before retiring this replica
+        (engine/fleet.py): an idle loop can stop with zero checkpoints
+        and zero evacuations.  Plain reads; safe from any thread."""
+        return (
+            not self.active
+            and not self._inflight_chunks
+            and not self._prefilling
+            and not self._swapping
+            and not self._pending_admissions
+            and not self._pending_wave
+            and self.queue.qsize() == 0
         )
 
     def interactive_load(self) -> tuple[bool, bool]:

@@ -38,6 +38,32 @@ log = logging.getLogger(__name__)
 _ENV = ("JAX_COORDINATOR", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
 
 
+def broadcast_params(donor_params, replicas):
+    """λScale-style scale-up param placement (arXiv 2502.09922): place
+    a NEW replica's params from a live donor engine's already-placed
+    device arrays instead of re-uploading the checkpoint pytree from
+    host memory.
+
+    ``donor_params`` leaves are committed jax.Arrays (immutable), so:
+
+    - same device / same sharding (the single-device fleet replicas
+      this serves today): ``device_put`` aliases — the spawn pays ZERO
+      param bytes, host or wire;
+    - different devices (per-replica device assignment, the multi-chip
+      follow-up): ``device_put`` of a device-resident array moves it
+      device→device over ICI, compiled by the runtime — never back
+      through the host, never through a checkpoint read.
+
+    This is the seam the multi-host story extends (one broadcast
+    collective over DCN instead of per-host checkpoint reads); the
+    single-controller serving path only ever hands it single-device
+    placements.  Routing through ``replicas.place_params`` keeps every
+    placement flavor (replicated, tensor-parallel spec trees) correct
+    without duplicating the sharding logic here.
+    """
+    return replicas.place_params(donor_params)
+
+
 def maybe_init_distributed(env: dict | None = None) -> bool:
     """Rendezvous this process into a multi-host JAX runtime when the
     JAX_COORDINATOR/… env trio is set; no-op (False) otherwise.

@@ -67,6 +67,17 @@ class AdmissionController:
         # pool hasn't claimed.
         self.paged = bool(getattr(engine, "paged_kv", False))
         self.pool = getattr(engine, "kv_pool", None)
+        # Elastic-fleet budget re-split (engine/fleet.py): a LEDGER cap
+        # in blocks below the pool's physical size — the fleet re-sets
+        # it on every scale/evict/rejoin event so the live replicas
+        # together keep honoring ONE fleet budget even though each
+        # pool's device buffers were sized at spawn time.  None
+        # (default, and every static deployment) = the physical pool is
+        # the ledger, bit-identical to the pre-elastic code.  The cap
+        # binds ADMISSION; in-slot decode growth still runs against the
+        # physical pool (a dry pool checkpoint-requeues, the existing
+        # machinery), so it is a soft budget — docs/autoscaling.md.
+        self.cap_blocks: int | None = None
         # Flight recorder (utils/tracing.py, engine-owned): admission's
         # down-class decisions land in the engine post-mortem ring.
         self.recorder = getattr(engine, "flight", None)
@@ -81,6 +92,37 @@ class AdmissionController:
 
     def _pool_bytes(self) -> int:
         return self.pool.used_bytes if (self.paged and self.pool) else 0
+
+    # -- elastic budget re-split (engine/fleet.py) ---------------------
+
+    def ledger_blocks(self) -> int:
+        """Blocks this replica's ledger may admit against: the physical
+        pool, capped by the fleet's live budget share."""
+        n = self.pool.num_blocks if self.pool is not None else 0
+        if self.cap_blocks is not None:
+            n = min(n, self.cap_blocks)
+        return n
+
+    def ledger_free_blocks(self) -> int:
+        if self.pool is None:
+            return 0
+        return max(0, self.ledger_blocks() - self.pool.used_blocks)
+
+    def set_budget(self, budget_bytes: int | None) -> None:
+        """Re-point this replica's share of the fleet KV budget (called
+        on every scale/evict/rejoin event).  Non-paged: the byte-ledger
+        bound moves.  Paged: the block cap moves (never the physical
+        pool — live streams hold its buffers).  None clears the split
+        (single-replica semantics)."""
+        if budget_bytes is None:
+            self.cap_blocks = None
+            return
+        budget_bytes = int(budget_bytes)
+        self.kv_budget_bytes = budget_bytes
+        if self.paged and self.pool is not None:
+            self.cap_blocks = max(
+                1, budget_bytes // max(1, self.pool.block_bytes)
+            )
 
     def note_pool(self) -> None:
         """Refresh the committed-bytes gauge off the pool (paged)."""
@@ -166,13 +208,13 @@ class AdmissionController:
             )
         if self.paged and self.pool is not None:
             initial, worst = self.engine.kv_blocks_estimate(feats)
-            if worst > self.pool.num_blocks:
+            if worst > self.ledger_blocks():
                 raise QueueFullError(
-                    f"request needs {worst} KV blocks, pool holds "
-                    f"{self.pool.num_blocks}",
+                    f"request needs {worst} KV blocks, ledger holds "
+                    f"{self.ledger_blocks()}",
                     reason="kv_budget",
                 )
-            if self.pool.free_blocks < initial and klass == INTERACTIVE:
+            if self.ledger_free_blocks() < initial and klass == INTERACTIVE:
                 # Transient pressure: wait it out in the lower class.
                 klass = BATCH
                 self._note_downclass(feats, "pool_pressure")
@@ -204,7 +246,7 @@ class AdmissionController:
         if self.draining:
             return False
         if self.paged and self.pool is not None:
-            return self.pool.free_blocks > 0
+            return self.ledger_free_blocks() > 0
         if self.kv_budget_bytes:
             with self._lock:
                 return self._committed < self.kv_budget_bytes
@@ -219,7 +261,7 @@ class AdmissionController:
         if self.paged and self.pool is not None:
             if getattr(item, "is_stream", False):
                 need = -(-getattr(item, "kv", 0) // self.pool.block_bytes)
-                return self.pool.free_blocks >= need
+                return self.ledger_free_blocks() >= need
             if not self.kv_budget_bytes:
                 return True
             with self._lock:

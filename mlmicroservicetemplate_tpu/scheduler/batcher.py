@@ -154,18 +154,25 @@ class Batcher:
         # single-loop path below, bit-identical to the pre-fleet code.
         self.fleet = None
         fleet_n = int(getattr(cfg, "fleet_replicas", 1) or 1)
+        # Elastic autoscaling (docs/autoscaling.md) needs the fleet
+        # wrapper even at an initial size of 1: FLEET_MAX_REPLICAS
+        # above the initial size is room the governor scales into.
+        fleet_max = int(getattr(cfg, "fleet_max_replicas", 0) or 0)
+        fleet_on = fleet_n > 1 or fleet_max > 1
         if getattr(engine.bundle, "kind", None) == "seq2seq" and getattr(
             cfg, "continuous_batching", True
         ):
-            if fleet_n > 1:
+            if fleet_on:
                 from ..engine.fleet import ReplicaFleet
 
                 self.fleet = ReplicaFleet(engine, cfg)
-                for rep in self.fleet.replicas:
-                    # MAX_STREAMS caps concurrent generations PER
-                    # replica; legacy per-stream traffic counts
-                    # against every replica's bound.
-                    rep.cdl.external_active = lambda: self._active_streams
+                # MAX_STREAMS caps concurrent generations PER replica;
+                # legacy per-stream traffic counts against every
+                # replica's bound — including replicas spawned later
+                # by the governor (the fleet wires the indirection).
+                self.fleet.external_active = (
+                    lambda: self._active_streams
+                )
                 # Introspection compatibility: /status.decode and
                 # /debug/engine read replica 0's loop; per-replica
                 # detail lives in /status.fleet.
@@ -190,12 +197,13 @@ class Batcher:
                         cfg, recorder=getattr(engine, "flight", None)
                     )
                     self._cdl.supervisor = self.supervisor
-        elif fleet_n > 1 and getattr(
+        elif fleet_on and getattr(
             engine.bundle, "kind", None
         ) == "seq2seq":
             raise ValueError(
-                "FLEET_REPLICAS>1 requires CONTINUOUS_BATCHING=1 (the "
-                "fleet replicates the continuous decode loop)"
+                "FLEET_REPLICAS>1 / FLEET_MAX_REPLICAS>1 requires "
+                "CONTINUOUS_BATCHING=1 (the fleet replicates the "
+                "continuous decode loop)"
             )
         # Bulk inference lane (JOBS_ENABLED; jobs/): the /v1/batches
         # job subsystem — a durable JobStore under JOURNAL_DIR/jobs

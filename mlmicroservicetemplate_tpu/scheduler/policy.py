@@ -140,6 +140,113 @@ class BackfillGovernor:
         return self.cap
 
 
+class ScalingGovernor:
+    """Decide when the replica fleet should grow or shrink
+    (engine/fleet.py drives ``ReplicaFleet`` off these decisions;
+    docs/autoscaling.md).
+
+    Pure policy over a load snapshot — no engines, no threads — so the
+    thresholds are unit-testable with an injected clock.  The signals
+    are the router's OWN load exports (λScale, arXiv 2502.09922: scale
+    off serving signals, not external monitors):
+
+    - **queue depth**: waiting streams per live replica ≥ ``up_queue``
+      → scale up (the queue is where overload becomes visible first);
+    - **committed KV**: the live fleet's committed-KV bytes at
+      ``up_kv_frac`` of its budget → scale up (memory saturates before
+      compute for long-context traffic);
+    - **TTFT EWMA**: the decode loops' time-to-first-chunk EWMA past
+      ``up_ttft_s`` → scale up (0 disables the signal — it needs a
+      deployment-calibrated threshold);
+    - **sustained lull**: total load (active + queued) would fit in
+      ``down_load`` of the SURVIVORS' slots for ``down_cooldown_s``
+      straight → scale down (the hysteresis that keeps a bursty
+      workload from flapping).
+
+    One step per decision (up OR down by 1): each event rebalances the
+    fleet budget and re-snapshots, so multi-step corrections converge
+    over a few ticks instead of overshooting on a stale signal.
+    ``note_event`` stamps the cooldowns when the fleet actually acted
+    (a failed spawn must not burn the cooldown silently).
+    """
+
+    def __init__(self, min_r: int, max_r: int, *, up_queue: float = 2.0,
+                 up_kv_frac: float = 0.85, up_ttft_s: float = 0.0,
+                 up_cooldown_s: float = 3.0, down_load: float = 0.25,
+                 down_cooldown_s: float = 10.0, clock=None):
+        self.min_r = max(1, int(min_r))
+        self.max_r = max(self.min_r, int(max_r))
+        self.up_queue = float(up_queue)
+        self.up_kv_frac = float(up_kv_frac)
+        self.up_ttft_s = float(up_ttft_s)
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_load = float(down_load)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self._last_up: float | None = None
+        self._low_since: float | None = None
+
+    def decide(self, *, live: int, queued: int, active: int,
+               slots: int, kv_frac: float = 0.0,
+               ttft_ewma_s: float = 0.0) -> tuple[str | None, str]:
+        """(direction, cause) for one governor tick.  direction is
+        "up" | "down" | None; cause labels the scale-event counter
+        (queue | kv | ttft | min | idle | steady)."""
+        now = self._clock()
+        if live <= 0:
+            # Nothing alive to compare load against: the rejoin path
+            # (engine/fleet.py) owns recovery, not the load policy.
+            return None, "dead"
+        if live < self.min_r:
+            return "up", "min"
+        up_ready = self._last_up is None or (
+            now - self._last_up >= self.up_cooldown_s
+        )
+        if live < self.max_r and up_ready:
+            if self.up_queue and queued >= self.up_queue * live:
+                return "up", "queue"
+            if self.up_kv_frac and kv_frac >= self.up_kv_frac:
+                return "up", "kv"
+            if self.up_ttft_s and ttft_ewma_s >= self.up_ttft_s:
+                return "up", "ttft"
+        if live > self.min_r:
+            survivors = live - 1
+            low = (active + queued) <= self.down_load * slots * survivors
+            if low:
+                if self._low_since is None:
+                    self._low_since = now
+                elif now - self._low_since >= self.down_cooldown_s:
+                    return "down", "idle"
+            else:
+                self._low_since = None
+        else:
+            self._low_since = None
+        return None, "steady"
+
+    def note_event(self, direction: str) -> None:
+        """The fleet actually scaled: stamp the cooldown clocks."""
+        now = self._clock()
+        if direction == "up":
+            self._last_up = now
+        self._low_since = None
+
+    def status(self) -> dict:
+        now = self._clock()
+        return {
+            "min": self.min_r,
+            "max": self.max_r,
+            "up_cooldown_remaining_s": (
+                round(max(
+                    0.0, self._last_up + self.up_cooldown_s - now
+                ), 3) if self._last_up is not None else 0.0
+            ),
+            "low_load_for_s": (
+                round(now - self._low_since, 3)
+                if self._low_since is not None else None
+            ),
+        }
+
+
 class DecodeWindowGovernor:
     """Pick the fused decode-window depth W for one dispatch
     (DECODE_WINDOW; engine/streams.py, docs/decode-fusion.md).

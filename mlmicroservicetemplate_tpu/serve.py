@@ -131,6 +131,22 @@ def main(argv: list[str] | None = None) -> None:
         "serving %s on %s:%d (device=%s, max_batch=%d)",
         bundle.name, cfg.host, cfg.port, cfg.device, cfg.max_batch,
     )
+    mn = cfg.fleet_min_replicas or cfg.fleet_replicas
+    mx = cfg.fleet_max_replicas or cfg.fleet_replicas
+    if mn != cfg.fleet_replicas or mx != cfg.fleet_replicas:
+        # Elastic fleet: capacity tracks traffic, not boot flags
+        # (docs/autoscaling.md).
+        log.info(
+            "autoscaling: fleet starts at %d, governor keeps it in "
+            "[%d, %d] (period=%gs, up: queue>=%g/replica or "
+            "kv>=%d%% of budget%s; down: load<=%d%% of survivor "
+            "slots for %gs)",
+            cfg.fleet_replicas, mn, mx, cfg.scale_period_s,
+            cfg.scale_up_queue, int(cfg.scale_up_kv_frac * 100),
+            f" or ttft>={cfg.scale_up_ttft_ms:g}ms"
+            if cfg.scale_up_ttft_ms else "",
+            int(cfg.scale_down_load * 100), cfg.scale_down_cooldown_s,
+        )
     if cfg.journal_dir:
         # Durable serving: the startup replay (api/app.py) re-admits
         # every incomplete journaled stream once the model is ready.

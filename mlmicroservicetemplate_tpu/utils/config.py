@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import os
 
-from pydantic import BaseModel, Field, field_validator
+from pydantic import BaseModel, Field, field_validator, model_validator
 
 _VALID_DEVICES = ("tpu", "cpu")
 
@@ -328,8 +328,37 @@ class ServiceConfig(BaseModel):
     fleet_breaker_n: int = 3
     # Seconds a breaker may sit open before the replica is evicted:
     # its streams failover to a healthy replica.  Half-open probes
-    # start at half this interval.
+    # start at half this interval.  Under elastic scaling this is ALSO
+    # the rejoin delay: an evicted replica is rebuilt through the
+    # scale-up path once it has been dead this long.
     fleet_evict_s: float = 10.0
+
+    # Elastic fleet (docs/autoscaling.md): live autoscaling bounds.
+    # FLEET_REPLICAS becomes the INITIAL size; the ScalingGovernor
+    # (scheduler/policy.py) moves the live count within
+    # [FLEET_MIN_REPLICAS, FLEET_MAX_REPLICAS] off the router's own
+    # load signals.  0 = same as FLEET_REPLICAS, and when BOTH bounds
+    # collapse onto FLEET_REPLICAS the fleet is STATIC — no governor
+    # thread, bit-identical to the pre-elastic code.
+    fleet_min_replicas: int = 0
+    fleet_max_replicas: int = 0
+    # Scale-UP triggers (evaluated per governor tick, live < max):
+    # waiting streams per live replica...
+    scale_up_queue: float = 2.0
+    # ...or committed-KV bytes as a fraction of the live fleet budget...
+    scale_up_kv_frac: float = 0.85
+    # ...or the decode loops' TTFT EWMA in ms (0 = signal off).
+    scale_up_ttft_ms: float = 0.0
+    # Minimum seconds between scale-up events (spin-up is cheap under
+    # donor broadcast but each event still recompiles executables).
+    scale_up_cooldown_s: float = 3.0
+    # Scale-DOWN trigger: total load (active + queued streams) would
+    # fit inside this fraction of the SURVIVORS' slots...
+    scale_down_load: float = 0.25
+    # ...sustained for this many seconds (the lull filter).
+    scale_down_cooldown_s: float = 10.0
+    # Governor tick period in seconds.
+    scale_period_s: float = 0.5
 
     # Fault tolerance (engine/faults.py + engine/supervisor.py).
     # Deterministic fault-injection schedule wrapped around the
@@ -565,6 +594,53 @@ class ServiceConfig(BaseModel):
             )
         return v
 
+    @field_validator("fleet_min_replicas", "fleet_max_replicas")
+    @classmethod
+    def _check_fleet_bounds_range(cls, v: int) -> int:
+        if not (0 <= v <= 64):
+            raise ValueError(
+                "FLEET_MIN/MAX_REPLICAS must be in [0, 64] (0 = "
+                "FLEET_REPLICAS)"
+            )
+        return v
+
+    @field_validator("scale_up_queue", "scale_up_cooldown_s",
+                     "scale_down_cooldown_s", "scale_up_ttft_ms")
+    @classmethod
+    def _check_scale_nonneg(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("SCALE_UP/DOWN_* thresholds must be >= 0")
+        return v
+
+    @field_validator("scale_up_kv_frac", "scale_down_load")
+    @classmethod
+    def _check_scale_frac(cls, v: float) -> float:
+        if not (0.0 <= v <= 1.0):
+            raise ValueError(
+                "SCALE_UP_KV_FRAC/SCALE_DOWN_LOAD must be in [0, 1]"
+            )
+        return v
+
+    @field_validator("scale_period_s")
+    @classmethod
+    def _check_scale_period(cls, v: float) -> float:
+        if v <= 0:
+            raise ValueError("SCALE_PERIOD_S must be > 0")
+        return v
+
+    @model_validator(mode="after")
+    def _check_fleet_elastic_bounds(self):
+        n = self.fleet_replicas
+        mn = self.fleet_min_replicas or n
+        mx = self.fleet_max_replicas or n
+        if not (mn <= n <= mx):
+            raise ValueError(
+                f"elastic fleet bounds must satisfy FLEET_MIN_REPLICAS "
+                f"<= FLEET_REPLICAS <= FLEET_MAX_REPLICAS, got "
+                f"{mn} <= {n} <= {mx}"
+            )
+        return self
+
     @field_validator("fault_spec")
     @classmethod
     def _check_fault_spec(cls, v: str | None) -> str | None:
@@ -630,6 +706,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
       ENGINE_RESTARTS_MAX, ENGINE_RESTART_WINDOW_S, SUPERVISE,
       FLEET_REPLICAS, FLEET_ROUTE, FLEET_BREAKER_N, FLEET_EVICT_S,
+      FLEET_MIN_REPLICAS, FLEET_MAX_REPLICAS, SCALE_UP_QUEUE,
+      SCALE_UP_KV_FRAC, SCALE_UP_TTFT_MS, SCALE_UP_COOLDOWN_S,
+      SCALE_DOWN_LOAD, SCALE_DOWN_COOLDOWN_S, SCALE_PERIOD_S,
       TRACE, TRACE_RING, FLIGHT_RING, PROFILE_DIR, LOG_FORMAT.
     """
     e = dict(os.environ)
@@ -688,6 +767,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "prefill_budget": "PREFILL_BUDGET",
         "prefill_max_prompt": "PREFILL_MAX_PROMPT",
         "decode_window": "DECODE_WINDOW",
+        "fleet_min_replicas": "FLEET_MIN_REPLICAS",
+        "fleet_max_replicas": "FLEET_MAX_REPLICAS",
         "fault_seed": "FAULT_SEED",
         "dispatch_retries": "DISPATCH_RETRIES",
         "engine_restarts_max": "ENGINE_RESTARTS_MAX",
@@ -716,6 +797,13 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
         ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),
         ("fleet_evict_s", "FLEET_EVICT_S"),
+        ("scale_up_queue", "SCALE_UP_QUEUE"),
+        ("scale_up_kv_frac", "SCALE_UP_KV_FRAC"),
+        ("scale_up_ttft_ms", "SCALE_UP_TTFT_MS"),
+        ("scale_up_cooldown_s", "SCALE_UP_COOLDOWN_S"),
+        ("scale_down_load", "SCALE_DOWN_LOAD"),
+        ("scale_down_cooldown_s", "SCALE_DOWN_COOLDOWN_S"),
+        ("scale_period_s", "SCALE_PERIOD_S"),
         ("engine_restart_window_s", "ENGINE_RESTART_WINDOW_S"),
     ):
         v = get(var)

@@ -183,6 +183,29 @@ FLEET_FAILOVERS = Counter(
     "re-routed for token-identical resume",
     ["model", "replica", "cause"],
 )
+FLEET_REPLICAS = Gauge(
+    "fleet_replicas",
+    "Fleet members by state: live (healthy-or-breaker-open, routable "
+    "pool), draining (scale-down in progress — finishing or evacuating "
+    "its streams), evicted (dead, awaiting rejoin), spawning (being "
+    "built/warmed/probed; not yet admitted to routing)",
+    ["model", "state"],
+)
+FLEET_SCALE_EVENTS = Counter(
+    "fleet_scale_events_total",
+    "Completed fleet scale events by direction and cause (up: queue | "
+    "kv | ttft | min | rejoin | manual, spawn_failed when the warm "
+    "probe died; down: idle | manual)",
+    ["model", "dir", "cause"],
+)
+FLEET_SCALE_DURATION = Histogram(
+    "fleet_scale_duration_seconds",
+    "Wall time one scale event took (up: engine build + donor param "
+    "broadcast + warm compile + probe dispatch; down: drain-or-"
+    "evacuate + retire)",
+    ["model", "dir"],
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
 FLEET_BREAKER = Gauge(
     "fleet_breaker_state",
     "Per-replica circuit breaker state: 0=closed (healthy), "

@@ -101,6 +101,24 @@ else
     echo "== fleet-failover smoke skipped (FLEET_SMOKE=0) =="
 fi
 
+# Autoscale smoke: an elastic fleet starts at R=1 (paged, int8),
+# batch-class load drives the governor's queue trigger until it
+# scales up via donor-param broadcast, a replica-scoped fatal
+# (r1:chunk:fatal) kills the new replica mid-decode, and the governor
+# must replace it after FLEET_EVICT_S with ZERO streams lost (every
+# stream token-identical) and every pool ledger drained (chaos tier,
+# so it stays out of tier-1).  SCALE_SMOKE=0 skips.
+if [ "${SCALE_SMOKE:-1}" != "0" ]; then
+    echo "== autoscale smoke (elastic [1..3] + r1:chunk:fatal) =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        SCALE_SMOKE_SPEC="${SCALE_SMOKE_SPEC:-r1:chunk:fatal@4}" \
+        python -m pytest \
+        tests/test_scaling.py::test_scale_smoke_load_up_kill_replace \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== autoscale smoke skipped (SCALE_SMOKE=0) =="
+fi
+
 # Tiered-KV smoke: the host-RAM swap path under a fatal chunk fault
 # with a tiny KV_HOST_BUDGET_MB — recovery must resume every stream
 # token-identically from the HOST copy, with zero re-prefill chunks

@@ -349,6 +349,46 @@ def test_all_dead_sheds_with_retry_after():
         fleet.stop()
 
 
+def test_all_dead_retry_after_reports_governor_replacement_eta():
+    """ISSUE 12 satellite: the all-dead Retry-After reports the SOONER
+    of the breaker half-open ETA and the governor's replacement
+    spin-up ETA (a dead replica rebuilds FLEET_EVICT_S after death,
+    within one governor period).  Pre-elastic, only the breaker clock
+    was consulted — a fleet 90% of the way to its rejoin still told
+    clients to wait the full half-open interval."""
+    clk = _Clock()
+    cfg = _cfg(fleet_replicas=2, fleet_min_replicas=1,
+               fleet_max_replicas=2, fleet_evict_s=20.0,
+               scale_period_s=0.5)
+    bundle = _echo_bundle()
+    eng = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    fleet = ReplicaFleet(eng, cfg, clock=clk, autoscale_thread=False)
+    try:
+        for rep in fleet.replicas:
+            fleet._mark_dead(rep, "budget")  # dead_at = 0
+        # Dead breakers report probe_after = evict_s/2 = 10s; the
+        # rejoin is due at t=20 → at t=18 the governor ETA (2s + one
+        # 0.5s period) wins.
+        clk.t = 18.0
+        assert fleet.retry_after_s() == pytest.approx(2.5)
+        with pytest.raises(QueueFullError) as ei:
+            fleet.submit_stream(text_feats(bundle.tokenizer, "x"))
+        assert ei.value.reason == "fleet_down"
+        assert ei.value.retry_after_s == pytest.approx(2.5)
+    finally:
+        fleet.stop()
+    # Static fleet: the breaker clock alone (historical behavior).
+    cfg2 = _cfg(fleet_replicas=2, fleet_evict_s=20.0)
+    eng2 = InferenceEngine(_echo_bundle(), cfg2, ReplicaSet(make_mesh(1)))
+    fleet2 = ReplicaFleet(eng2, cfg2, clock=clk, autoscale_thread=False)
+    try:
+        for rep in fleet2.replicas:
+            fleet2._mark_dead(rep, "budget")
+        assert fleet2.retry_after_s() == pytest.approx(10.0)
+    finally:
+        fleet2.stop()
+
+
 def test_breaker_eviction_requests_evacuation():
     """A breaker stuck open past FLEET_EVICT_S retires the replica on
     the next sweep, even with no fault currently in flight."""

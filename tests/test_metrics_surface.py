@@ -155,6 +155,55 @@ def test_every_declared_series_present_and_bounded():
     assert 'requests_shed_total{model="gpt2",reason="drain"}' in text
 
 
+def test_fleet_scaling_series_present_after_scale_events():
+    """Elastic-fleet observability (ISSUE 12 satellite): one manual
+    scale-up + scale-down on an elastic fleet produces samples for
+    ``fleet_replicas{state=...}`` (all four states declared, live
+    tracking the roster), ``fleet_scale_events_total{dir,cause}`` and
+    ``fleet_scale_duration_seconds`` in a real scrape."""
+    if not metrics.HAVE_PROM:
+        pytest.skip("prometheus_client not installed")
+    from mlmicroservicetemplate_tpu.engine.fleet import ReplicaFleet
+
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2, 4),
+        seq_buckets=(16, 32), max_decode_len=8,
+        stream_chunk_tokens=4, max_streams=2,
+        fleet_replicas=1, fleet_max_replicas=2,
+    )
+    bundle = tiny_gpt_bundle()
+    engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    fleet = ReplicaFleet(engine, cfg, autoscale_thread=False)
+    try:
+        assert fleet.scale_to(2, cause="manual") == 2
+        assert fleet.scale_to(1, cause="manual") == 1
+    finally:
+        fleet.stop()
+    text = _scrape_body()
+    for name in ("fleet_replicas", "fleet_scale_events_total",
+                 "fleet_scale_duration_seconds"):
+        assert f"# HELP {name}" in text or f"# HELP {name}_" in text, (
+            f"{name} missing from /metrics"
+        )
+    for state in ("live", "draining", "evicted", "spawning"):
+        assert f'fleet_replicas{{model="gpt2",state="{state}"}}' in text, (
+            f"fleet_replicas state {state!r} has no sample"
+        )
+    assert 'fleet_replicas{model="gpt2",state="live"} 1.0' in text
+    assert ('fleet_scale_events_total'
+            '{cause="manual",dir="up",model="gpt2"}') in text
+    assert ('fleet_scale_events_total'
+            '{cause="manual",dir="down",model="gpt2"}') in text
+    up = [ln for ln in text.splitlines() if ln.startswith(
+        'fleet_scale_duration_seconds_count{dir="up",model="gpt2"}'
+    )]
+    down = [ln for ln in text.splitlines() if ln.startswith(
+        'fleet_scale_duration_seconds_count{dir="down",model="gpt2"}'
+    )]
+    assert up and float(up[0].rsplit(" ", 1)[1]) >= 1
+    assert down and float(down[0].rsplit(" ", 1)[1]) >= 1
+
+
 def test_job_series_present_after_bulk_smoke(tmp_path):
     """Bulk-lane observability (ISSUE 11 satellite): one tiny job
     through a JOBS_ENABLED app produces samples for the job series —

@@ -66,7 +66,19 @@ def _spec_generate(family, params, cfg, ids, mask, max_len, spec_k=4, ngram=2,
             emitted[b].extend(
                 int(t) for t in spec_mod.flatten_emitted(out_np, ns_np, b)
             )
-        if bool(done_np.all()) or min(len(e) for e in emitted) >= max_len:
+        # Per-ROW termination: a row is finished when it EOS'd (done)
+        # OR hit the budget.  The old min-based exit hung forever on
+        # mixed batches — one row EOSing on its first token pins
+        # min(len) at 1 while a never-EOSing row decodes past every
+        # budget (the engine caps by budget host-side; this raw-chunk
+        # harness must do the same per row).  That was the whole
+        # test_spec_token_identity_llama "failure": a harness
+        # convergence bug, not an identity break — the emitted
+        # prefixes always matched greedy.
+        if all(
+            bool(done_np[b]) or len(emitted[b]) >= max_len
+            for b in range(ids.shape[0])
+        ):
             break
         assert rounds < max_len * 4, "spec loop failed to converge"
     return emitted, rounds
@@ -153,7 +165,12 @@ def _t5_spec_generate(params, cfg, ids, mask, max_len, spec_k=4, ngram=2,
             emitted[b].extend(
                 int(t) for t in spec_mod.flatten_emitted(out_np, ns_np, b)
             )
-        if bool(done_np.all()) or min(len(e) for e in emitted) >= max_len:
+        # Same per-row termination as _spec_generate (a mixed early-
+        # EOS + never-EOS batch must not hang the harness).
+        if all(
+            bool(done_np[b]) or len(emitted[b]) >= max_len
+            for b in range(ids.shape[0])
+        ):
             break
         assert rounds < max_len * 4, "t5 spec loop failed to converge"
     return emitted, rounds
