@@ -29,7 +29,7 @@ import numpy as np
 
 from ..models.registry import KIND_IMAGE, KIND_SEQ2SEQ, KIND_TEXT, ModelBundle
 from ..parallel import ReplicaSet, make_mesh
-from ..utils import metrics, tracing
+from ..utils import locktrace, metrics, tracing
 
 log = logging.getLogger(__name__)
 
@@ -750,6 +750,10 @@ class InferenceEngine:
         ``block_until_ready``'d to measure the device half — the
         host-vs-device split per site — at the documented cost of
         serializing the dispatch pipeline (attribution mode)."""
+        if locktrace.is_active():
+            # LOCKTRACE=1: flag locks held across this dispatch (a
+            # relay RTT under a lock stalls every thread needing it).
+            locktrace.note_dispatch(site)
         tr = tracing.tracer()
         if tr is None:
             t0 = time.perf_counter()
@@ -1060,12 +1064,18 @@ class InferenceEngine:
             with self._lock:
                 # First chunk fused with encode+init (and routed
                 # through the per-request prefix cache): TTFT = one
-                # round-trip.
-                state, toks, sampled = self.start_fused(feats)
+                # round-trip.  Guarded like the continuous loop's
+                # dispatches (r18): the per-stream path used to bypass
+                # the watchdog/fault-injector entirely.
+                state, toks, sampled = self.dispatch_guard(
+                    "prefill", lambda: self.start_fused(feats)
+                )
                 # One transfer for tokens+done — each device_get pays a
                 # full relay round-trip, so never fetch them
                 # separately.
-                toks_np, done_np = jax.device_get((toks, state.done))
+                toks_np, done_np = self.dispatch_guard(
+                    "fetch", lambda: jax.device_get((toks, state.done))
+                )
                 chunk, done = toks_np[0], bool(done_np[0])
             # Request max_tokens bounds chunk spending, and the final
             # chunk trims to the exact budget — raw emission never
@@ -1079,10 +1089,16 @@ class InferenceEngine:
                 return
             while produced < budget:
                 with self._lock:
-                    state, toks = self._gen_chunk(
-                        self.params, state, self.chunk_tokens, sampled
+                    state, toks = self.dispatch_guard(
+                        "chunk",
+                        lambda: self._gen_chunk(
+                            self.params, state, self.chunk_tokens, sampled
+                        ),
                     )
-                    toks_np, done_np = jax.device_get((toks, state.done))
+                    toks_np, done_np = self.dispatch_guard(
+                        "fetch",
+                        lambda: jax.device_get((toks, state.done)),
+                    )
                     chunk, done = toks_np[0], bool(done_np[0])
                 yield chunk[: budget - produced]
                 produced += self.chunk_tokens
@@ -1130,9 +1146,13 @@ class InferenceEngine:
                 ids, mask, _ = self._collate_text([sfeats])
                 sp, _ = self._collate_sample([feats], ids.shape[0])
                 ids, mask = self.replicas.place_batch(ids, mask)
-                ss, out, ns = self._spec_start_prefixed(
-                    self.params, pkv, row_ids[:p_len], ids, mask,
-                    sp, self.max_decode_len, n_verify, self.spec_k, sampled,
+                ss, out, ns = self.dispatch_guard(
+                    "prefill",
+                    lambda: self._spec_start_prefixed(
+                        self.params, pkv, row_ids[:p_len], ids, mask,
+                        sp, self.max_decode_len, n_verify, self.spec_k,
+                        sampled,
+                    ),
                 )
                 # Growing conversations keep donating from the hit
                 # path (same rule as start_fused): capture the largest
@@ -1150,9 +1170,13 @@ class InferenceEngine:
                 ids, mask, _ = self._collate_text([feats])
                 sp, _ = self._collate_sample([feats], ids.shape[0])
                 ids, mask = self.replicas.place_batch(ids, mask)
-                ss, out, ns = self._spec_start(
-                    self.params, ids, mask, sp,
-                    self.max_decode_len, n_verify, self.spec_k, sampled,
+                ss, out, ns = self.dispatch_guard(
+                    "prefill",
+                    lambda: self._spec_start(
+                        self.params, ids, mask, sp,
+                        self.max_decode_len, n_verify, self.spec_k,
+                        sampled,
+                    ),
                 )
                 if prefix_cache is not None:
                     p_ins = prefix_cache.bucket_for_insert(length)
@@ -1163,7 +1187,9 @@ class InferenceEngine:
                             row_ids, p_ins,
                             self._capture_prefix(ss.base, p_ins),
                         )
-            out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
+            out_np, ns_np, done_np = self.dispatch_guard(
+                "fetch", lambda: jax.device_get((out, ns, ss.base.done))
+            )
         chunk = flatten_emitted(out_np, ns_np, 0)
         metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
             int(chunk.size) / max(1, n_verify)
@@ -1184,21 +1210,30 @@ class InferenceEngine:
         while not done and produced < budget:
             with self._lock:
                 if ahead is None:
-                    ahead = self._spec_chunk(
-                        self.params, ss, n_verify, self.spec_k, sampled
+                    ahead = self.dispatch_guard(
+                        "chunk",
+                        lambda: self._spec_chunk(
+                            self.params, ss, n_verify, self.spec_k, sampled
+                        ),
                     )
                 ss, out, ns = ahead
                 ahead = None
                 if produced + n_verify < budget:  # ≥1 token per round
-                    ahead = self._spec_chunk(
-                        self.params, ss, n_verify, self.spec_k, sampled
+                    ahead = self.dispatch_guard(
+                        "chunk",
+                        lambda: self._spec_chunk(
+                            self.params, ss, n_verify, self.spec_k, sampled
+                        ),
                     )
                 for arr in (out, ns, ss.base.done):
                     try:
                         arr.copy_to_host_async()
                     except Exception:
                         pass
-                out_np, ns_np, done_np = jax.device_get((out, ns, ss.base.done))
+                out_np, ns_np, done_np = self.dispatch_guard(
+                    "fetch",
+                    lambda: jax.device_get((out, ns, ss.base.done)),
+                )
             chunk = flatten_emitted(out_np, ns_np, 0)
             metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
                 int(chunk.size) / max(1, n_verify)

@@ -29,8 +29,13 @@ FAULT_SPEC grammar (``;``-separated rules)::
     rule   := [replica ":"] [site ":"] kind ["(" seconds ")"] trigger
     replica:= "r" N           rule applies only to fleet replica N
                               (default: every replica, independently)
-    site   := prefill | prefill_chunk | chunk | fetch | batch | grow | *
-              (default *; prefill_chunk = one chunked-prefill window)
+    site   := prefill | prefill_chunk | chunk | fetch | batch | grow
+            | handoff | swap | *
+              (default *; prefill_chunk = one chunked-prefill window,
+              handoff = a slot-insert flipping a prefilled/swapped
+              stream live, swap = KV-tier gather/scatter/materialize
+              traffic — both r18 sites, so older chunk@N schedules
+              never renumber)
     kind   := transient | fatal | hang | oob
     trigger:= "@" N ["+" M]   fire on matching dispatches N..N+M-1
             | "~" RATE        fire with probability RATE per dispatch
@@ -59,7 +64,8 @@ from ..utils import metrics
 
 log = logging.getLogger(__name__)
 
-SITES = ("prefill", "prefill_chunk", "chunk", "fetch", "batch", "grow", "*")
+SITES = ("prefill", "prefill_chunk", "chunk", "fetch", "batch", "grow",
+         "handoff", "swap", "*")
 KINDS = ("transient", "fatal", "hang", "oob")
 
 

@@ -77,7 +77,7 @@ from collections import deque
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import locktrace, metrics
 
 log = logging.getLogger(__name__)
 
@@ -269,6 +269,13 @@ class ReplicaFleet:
         # on a draining loop whose evacuation callback takes ``_lock``
         # — holding ``_lock`` across the wait would deadlock.
         self._scale_lock = threading.Lock()
+        # LOCKTRACE adjudication: the scale lock IS deliberately held
+        # across the spawn's warm-probe dispatch — one scale event at
+        # a time is the invariant, and nothing on the serving path
+        # ever takes this lock (the governor thread and manual
+        # scale_to are its only users), so a slow probe delays only
+        # the next scale decision, never traffic.
+        locktrace.allow_across_dispatch(self._scale_lock)
         self.failovers = 0
         self.scale_period_s = float(
             getattr(cfg, "scale_period_s", 0.5) or 0.5
@@ -590,9 +597,18 @@ class ReplicaFleet:
             ).inc()
             healthy = self.healthy_replicas()
             moved = lost = 0
+            j = getattr(rep.engine, "journal", None)
             for st in streams:
                 target = self.router.pick_adopter(healthy)
                 if target is None:
+                    # WRITE-AHEAD terminal record before the consumer
+                    # sees the error: without it a restart's journal
+                    # replay resurrects a stream its client already
+                    # watched die (the client saw an error, the journal
+                    # still said "incomplete").
+                    if j is not None and st.rid and not st.done_journaled:
+                        j.done(st.rid)
+                        st.done_journaled = True
                     st.emit(
                         exc if isinstance(exc, Exception)
                         else RuntimeError(f"replica {rep.id} died: {exc}")

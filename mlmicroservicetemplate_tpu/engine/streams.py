@@ -96,7 +96,7 @@ class _Stream:
         "budget", "klass", "deadline", "started", "kv", "kv_held",
         "skip", "tokens", "preempted", "t_in", "_removed",
         "blocks", "s_base", "s_lo", "shared_ids", "swap",
-        "rid", "t_queued", "t_emit",
+        "rid", "t_queued", "t_emit", "done_journaled",
     )
 
     # Admission-ledger marker: paged mode accounts streams via the
@@ -148,6 +148,11 @@ class _Stream:
         self.rid = str(feats.get("request_id") or "")
         self.t_queued = self.t_in
         self.t_emit = 0.0
+        # Write-ahead terminal marker: the journal's ``done`` record
+        # must land BEFORE the consumer can observe the stream's end
+        # (_journal_done), and exactly once across the emit site and
+        # the release path.
+        self.done_journaled = False
 
     def emit(self, item: Any) -> None:
         try:
@@ -682,11 +687,12 @@ class ContinuousDecodeLoop:
         a stream that never reached the loop thread)."""
         if not st.released:
             st.released = True
-            j = self._journal()
-            if j is not None and st.rid:
-                # Terminal journal record: replay must not resume this
-                # stream (delivered in full, errored, or cancelled).
-                j.done(st.rid)
+            # Terminal journal record: replay must not resume this
+            # stream (delivered in full, errored, or cancelled).
+            # Usually already written by _journal_done at the emit
+            # site (write-ahead); this covers streams that end with
+            # no terminal emission (consumer cancelled).
+            self._journal_done(st)
             self._drop_swap(st, disk_too=True)  # terminal: no reader left
             if self.admission is not None:
                 self.admission.release(st)
@@ -745,7 +751,22 @@ class ContinuousDecodeLoop:
                 self.engine.bundle.name, "stream", klass
             ).set(self.queue.waiting(klass))
 
+    def _journal_done(self, st: _Stream) -> None:
+        """WRITE-AHEAD terminal record, exactly once: the journal must
+        learn a stream is over BEFORE the consumer can observe its end.
+        The converse order loses the race a kill -9 runs against it —
+        the client sees the stream finish, the journal still holds it
+        incomplete, and restart replay resurrects (and re-runs) a
+        stream its consumer already closed (graftlint: write-ahead)."""
+        if st.done_journaled:
+            return
+        j = self._journal()
+        if j is not None and st.rid:
+            j.done(st.rid)
+        st.done_journaled = True
+
     def _finish(self, st: _Stream, item: Any = _END) -> None:
+        self._journal_done(st)
         st.emit(item)
         self._release(st)
 
@@ -805,6 +826,7 @@ class ContinuousDecodeLoop:
         for slot in list(self.active):
             st = self.active.get(slot)
             if st is not None:
+                self._journal_done(st)
                 st.emit(exc)
             self._free_slot(slot)
         self._inflight_chunks.clear()
@@ -1012,6 +1034,7 @@ class ContinuousDecodeLoop:
                 for slot in list(self.active):
                     st = self.active.get(slot)
                     if st is not None:
+                        self._journal_done(st)
                         st.emit(e)
                         n_lost += 1
                     self._free_slot(slot)
@@ -1054,6 +1077,7 @@ class ContinuousDecodeLoop:
         for slot in list(self.active):
             st = self.active.get(slot)
             if st is not None:
+                self._journal_done(st)
                 st.emit(StreamClosedError("server stopping"))
             self._free_slot(slot)
 
@@ -1462,6 +1486,7 @@ class ContinuousDecodeLoop:
             self.failover(harvested, exc, cause)
         else:  # defensive: no fleet attached — error-terminate
             for st in harvested:
+                self._journal_done(st)
                 st.emit(exc)
 
     # -- preemption ----------------------------------------------------
@@ -2223,6 +2248,7 @@ class ContinuousDecodeLoop:
                 )
                 job.s_total = p_len + s_suf
                 with eng._lock:
+                    # graftlint: unguarded(detached empty-state template build — no stream tokens flow; failures classify via the caller's _fail_streams, and guarding would renumber the pinned prefill_chunk schedules)
                     job.state = self._empty_prefill_fn()(
                         eng.params, 1, job.s_total, eng.max_decode_len
                     )
@@ -2353,9 +2379,16 @@ class ContinuousDecodeLoop:
                     int(getattr(eng.bundle.cfg, "pad_id", 0)), np.int32,
                 )
                 with eng._lock:
-                    self._state = self._paged_handoff_fn()(
-                        self._state, kv_row, w_idx, zero, last, not_done,
-                        toks_row, sp, np.int32(slot),
+                    # ``handoff`` dispatch site: row surgery flipping a
+                    # prefilled/swapped stream live — its own site so
+                    # guarding it never renumbers the chunk/prefill
+                    # schedules chaos tests pin.
+                    self._state = eng.dispatch_guard(
+                        "handoff",
+                        lambda: self._paged_handoff_fn()(
+                            self._state, kv_row, w_idx, zero, last,
+                            not_done, toks_row, sp, np.int32(slot),
+                        ),
                     )
                 st.blocks = job.sb
                 job.sb = None
@@ -2369,9 +2402,16 @@ class ContinuousDecodeLoop:
                     done=not_done, sample=sp,
                 )
                 with eng._lock:
-                    self._state = self._insert_fn()(
-                        self._state, final, np.int32(slot), np.int32(0)
+                    self._state = eng.dispatch_guard(
+                        "handoff",
+                        lambda: self._insert_fn()(
+                            self._state, final, np.int32(slot), np.int32(0)
+                        ),
                     )
+        # The stream is not active yet, so there is no checkpoint to
+        # classify-route; a dead device resurfaces at the next guarded
+        # chunk dispatch, which the supervisor classifies and owns.
+        # graftlint: except(pre-active handoff failure errors only this stream; no checkpoint exists to route)
         except Exception as e:
             if slot is not None:
                 self.free.append(slot)
@@ -2586,6 +2626,7 @@ class ContinuousDecodeLoop:
             ids, mask, _ = eng._collate_text([feats])
             sp, _ = eng._collate_sample([feats], ids.shape[0])
             ids, mask = eng.replicas.place_batch(ids, mask)
+            # graftlint: unguarded(all-dead template build carries no stream data; it rebuilds at recovery, where guarding would renumber every deterministic FAULT_SPEC schedule the chaos suites pin)
             template, _ = eng._start(
                 eng.params, ids, mask, sp, eng.max_decode_len, eng.chunk_tokens, False
             )
@@ -2623,7 +2664,9 @@ class ContinuousDecodeLoop:
         # prefill-state) insert pair would then recompile on the first
         # real admission (measured ~1-8 s through the relay) because
         # warm() only ever saw NamedSharding-carrying states.
+        # graftlint: unguarded(pure placement of a host-built zero template with explicit sharding; retry-safe but carries no compute — a lost device surfaces at the next guarded dispatch)
         self._state = jax.device_put(empty, eng.replicas.batch_sharding)
+        # graftlint: unguarded(same placement barrier as the device_put above)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
 
     def _build_empty_paged(self, template) -> None:
@@ -2684,7 +2727,9 @@ class ContinuousDecodeLoop:
                 template.sample,
             ),
         )
+        # graftlint: unguarded(pure placement of a host-built zero template with explicit sharding; retry-safe but carries no compute — a lost device surfaces at the next guarded dispatch)
         self._state = jax.device_put(empty, eng.replicas.batch_sharding)
+        # graftlint: unguarded(same placement barrier as the device_put above)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
         # Host tier buffers build once the pool leaf shapes are known.
         tier = self._host_tier()
@@ -3145,8 +3190,15 @@ class ContinuousDecodeLoop:
             list(block_ids) + [block_ids[-1]] * (pad - nb), np.int32
         )
         with self.engine._lock:
-            leaves = jax.tree.leaves(
-                self._swap_gather_fn()(self._state, pids)
+            # Guarded at the ``swap`` site: a wedged relay on the
+            # gather dispatch hits the watchdog instead of stalling
+            # the loop, and swap chaos schedules (swap:fatal@N) can
+            # target tier traffic without renumbering chunk sites.
+            leaves = self.engine.dispatch_guard(
+                "swap",
+                lambda: jax.tree.leaves(
+                    self._swap_gather_fn()(self._state, pids)
+                ),
             )
         prefetch_to_host(*leaves)
         return leaves
@@ -3244,7 +3296,14 @@ class ContinuousDecodeLoop:
         for entry, leaves, nb, free_ids in pending:
             try:
                 if entry.alive:
-                    vals = [np.asarray(x)[:nb] for x in leaves]
+                    # Materialization is a device→host fetch (the async
+                    # copies usually landed; when they didn't, this
+                    # blocks on the wire) — guarded at the swap site so
+                    # a wedged relay hits the watchdog, not the loop.
+                    vals = self.engine.dispatch_guard(
+                        "swap",
+                        lambda: [np.asarray(x)[:nb] for x in leaves],
+                    )
                     entry.pool.write(entry.ids, vals)
                     entry.ready = True
                     if (
@@ -3264,6 +3323,7 @@ class ContinuousDecodeLoop:
                                 "disk write-through failed (resume "
                                 "still host-served)"
                             )
+            # graftlint: except(every swap-out failure lands on the recompute resume — classification cannot change the outcome, the entry is released either way)
             except Exception:
                 log.exception("KV swap materialize failed")
                 ledger = getattr(entry, "ledger", None)
@@ -3342,7 +3402,12 @@ class ContinuousDecodeLoop:
             if K > n else v
             for v in vals
         ]
-        self._state = self._swap_scatter_fn()(self._state, ids_p, vals_p)
+        # Guarded swap-site dispatch: prefetch scatters get the same
+        # watchdog/retry/attribution coverage as every other dispatch.
+        self._state = self.engine.dispatch_guard(
+            "swap",
+            lambda: self._swap_scatter_fn()(self._state, ids_p, vals_p),
+        )
 
     def _start_swapin(self, st: _Stream) -> bool:
         """Begin a host→device swap resume: allocate the device blocks
@@ -4077,6 +4142,7 @@ class ContinuousDecodeLoop:
                 self._emit_tokens(st, toks_np[slot])
                 st.produced += eng.chunk_tokens
             if bool(done_np[slot]) or st.produced >= st.budget:
+                self._journal_done(st)
                 st.emit(_END)
                 self._free_slot(slot)
 
@@ -4435,9 +4501,11 @@ class ContinuousDecodeLoop:
             with eng._lock:
                 s = self._state
                 for _ in range(k):
+                    # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                     s, toks = self._paged_chunk_fn()(
                         eng.params, s, table, eng.chunk_tokens, False
                     )
+                # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                 jax.device_get(toks)
             self._state = s
             return _time.perf_counter() - t0
@@ -4488,14 +4556,17 @@ class ContinuousDecodeLoop:
                 s = self._state
                 for _ in range(k):
                     if self.spec:
+                        # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                         s, toks, _ = eng._spec_chunk(
                             eng.params, s, eng.chunk_tokens, eng.spec_k,
                             False,
                         )
                     else:
+                        # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                         s, toks = eng._gen_chunk(
                             eng.params, s, eng.chunk_tokens, False
                         )
+                # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                 jax.device_get(toks)
             self._state = s
             return _time.perf_counter() - t0

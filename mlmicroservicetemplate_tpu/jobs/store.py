@@ -211,6 +211,7 @@ class JobStore:
                     }
                     if rec.get("error"):
                         row["error"] = str(rec["error"])
+                    # graftlint: write-ahead(replay reader — this record was already journaled on disk; _apply only materializes it)
                     job.results[i] = row
         elif k == "state":
             job = self.jobs.get(jid)

@@ -713,7 +713,17 @@ class Batcher:
                     ),
                 )
         except Exception as e:
-            if rep is not None:
+            # Classify before the breaker hears about it: only DEVICE
+            # faults (transient link errors, fatal device loss, watchdog
+            # timeouts) indict the replica.  Poison input — a collate
+            # ValueError, a preprocess bug — fails only its own batch;
+            # without this gate FLEET_BREAKER_N malformed requests open
+            # the breaker and evict a perfectly healthy replica.
+            from ..engine import faults
+
+            if rep is not None and (
+                faults.is_transient(e) or faults.is_fatal_device(e)
+            ):
                 rep.breaker.record_fault()
                 self.fleet._refresh_gauges()
             for item in batch:

@@ -310,7 +310,7 @@ class DeadlineQueue:
     ``_removed`` flag for lazy heap deletion.
     """
 
-    def __init__(self, maxsize: int, weight: int = 4):
+    def __init__(self, maxsize: int, weight: int = 4, clock=None):
         self.maxsize = max(1, int(maxsize))
         self.weight = max(1, int(weight))
         self._heaps: dict[str, list] = {k: [] for k in CLASSES}
@@ -318,6 +318,10 @@ class DeadlineQueue:
         self._cond = threading.Condition()
         self._seq = itertools.count()
         self._streak = 0  # consecutive interactive pops while batch waits
+        # Injectable clock (graftlint: clock-injection) — expiry and
+        # pop timeouts pin in tests without sleeping through real
+        # deadlines; item deadlines stay absolute seconds on this clock.
+        self._clock = clock if clock is not None else time.monotonic
 
     # -- introspection -------------------------------------------------
 
@@ -421,14 +425,14 @@ class DeadlineQueue:
 
     def pop(self, timeout: float | None = None, fits=None):
         """Blocking pop for the decode-loop thread."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
             while True:
                 item = self._pop_locked(fits)
                 if item is not None:
                     return item
                 remaining = (
-                    None if deadline is None else deadline - time.monotonic()
+                    None if deadline is None else deadline - self._clock()
                 )
                 if remaining is not None and remaining <= 0:
                     return None
@@ -490,7 +494,7 @@ class DeadlineQueue:
         """Remove and return every waiter whose deadline passed (the
         caller fails them with ``DeadlineExceededError`` → 504).
         Started items never expire."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         out = []
         with self._cond:
             for klass in CLASSES:

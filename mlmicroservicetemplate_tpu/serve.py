@@ -47,6 +47,12 @@ def parse_args(argv: list[str] | None = None) -> dict:
 
 def build_service(overrides: dict | None = None):
     """Assemble (cfg, bundle, engine, batcher, app) without running it."""
+    # LOCKTRACE=1: install the lock-order detector BEFORE any engine
+    # lock exists (docs/static-analysis.md) — locks created earlier
+    # stay untraced.
+    from .utils import locktrace
+
+    locktrace.auto_install()
     from .utils.config import load_config
 
     cfg = load_config(overrides)

@@ -413,6 +413,92 @@ class ServiceConfig(BaseModel):
     # (POST /debug/profile); None = $PROFILE_DIR or /tmp/jax-trace.
     profile_dir: str | None = None
 
+    # ------------------------------------------------------------------
+    # r18 (graftlint knob-drift): every knob fails fast on garbage at
+    # boot instead of surfacing as a serving-path error hours later.
+
+    @field_validator("model_name")
+    @classmethod
+    def _check_model_name(cls, v: str) -> str:
+        if not v.strip():
+            raise ValueError("MODEL_NAME must be non-empty")
+        return v
+
+    @field_validator("host")
+    @classmethod
+    def _check_host(cls, v: str) -> str:
+        if not v.strip():
+            raise ValueError("HOST must be non-empty")
+        return v
+
+    @field_validator("port")
+    @classmethod
+    def _check_port(cls, v: int) -> int:
+        if not (1 <= v <= 65535):
+            raise ValueError("PORT must be in [1, 65535]")
+        return v
+
+    @field_validator("max_queue", "pipeline_depth", "max_decode_len",
+                     "stream_chunk_tokens", "max_streams",
+                     "register_max_tries", "spec_max_streams")
+    @classmethod
+    def _check_pos_int(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError(
+                "MAX_QUEUE/PIPELINE_DEPTH/MAX_DECODE_LEN/"
+                "STREAM_CHUNK_TOKENS/MAX_STREAMS/REGISTER_MAX_TRIES/"
+                "SPEC_MAX_STREAMS must be >= 1"
+            )
+        return v
+
+    @field_validator("replicas", "sp", "tp", "stream_pipeline",
+                     "max_stream_queue", "fault_seed")
+    @classmethod
+    def _check_nonneg_knob_int(cls, v: int) -> int:
+        if v < 0:
+            raise ValueError(
+                "REPLICAS/SP/TP/STREAM_PIPELINE/MAX_STREAM_QUEUE/"
+                "FAULT_SEED must be >= 0 (0 = auto/off)"
+            )
+        return v
+
+    @field_validator("batch_timeout_ms", "register_retry_s",
+                     "register_heartbeat_s", "prefix_cache_mb",
+                     "deadline_ms", "kv_budget_mb", "drain_grace_s")
+    @classmethod
+    def _check_nonneg_knob_float(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError(
+                "BATCH_TIMEOUT_MS/REGISTER_RETRY_S/REGISTER_HEARTBEAT_S/"
+                "PREFIX_CACHE_MB/DEADLINE_MS/KV_BUDGET_MB/DRAIN_GRACE_S "
+                "must be >= 0"
+            )
+        return v
+
+    @field_validator("batch_buckets", "seq_buckets")
+    @classmethod
+    def _check_buckets(cls, v: tuple[int, ...]) -> tuple[int, ...]:
+        if not v:
+            raise ValueError("BATCH_BUCKETS/SEQ_BUCKETS must be non-empty")
+        if any(b < 1 for b in v):
+            raise ValueError("bucket sizes must be >= 1")
+        if list(v) != sorted(set(v)):
+            raise ValueError(
+                "BATCH_BUCKETS/SEQ_BUCKETS must be strictly ascending "
+                f"(got {v})"
+            )
+        return v
+
+    @field_validator("log_level")
+    @classmethod
+    def _check_log_level(cls, v: str) -> str:
+        if v.upper() not in ("DEBUG", "INFO", "WARNING", "ERROR",
+                             "CRITICAL"):
+            raise ValueError(
+                f"LOG_LEVEL must be a standard logging level, got {v!r}"
+            )
+        return v
+
     @field_validator("quantize")
     @classmethod
     def _check_quantize(cls, v: str | None) -> str | None:

@@ -321,8 +321,9 @@ DISPATCH_HOST = Histogram(
     "dispatch_host_seconds",
     "Host time one guarded device dispatch spent from submit to "
     "return, by dispatch site (prefill | prefill_chunk | chunk | "
-    "fetch | batch) — the host-side half of the host-vs-device "
-    "attribution split (TRACE=1 spans carry the device half)",
+    "fetch | batch | handoff | swap) — the host-side half of the "
+    "host-vs-device attribution split (TRACE=1 spans carry the "
+    "device half)",
     ["model", "site"], buckets=_FINE_BUCKETS,
 )
 JOURNAL_FSYNC = Histogram(

@@ -14,6 +14,14 @@ os.environ["XLA_FLAGS"] = (
 # Serving defaults that keep tests fast.
 os.environ.setdefault("WARMUP", "0")
 
+# LOCKTRACE=1 (scripts/check.sh chaos stages; docs/static-analysis.md):
+# install the lock-order detector BEFORE any engine module creates a
+# lock — the fixture below then asserts no inversion / held-across-
+# dispatch violation per test.  Stdlib-only import, safe pre-jax.
+from mlmicroservicetemplate_tpu.utils import locktrace  # noqa: E402
+
+locktrace.auto_install()
+
 # XLA CPU's default conv/matmul precision is reduced (bf16-ish passes);
 # golden tests need real f32 math. jax may already be imported by the
 # environment's sitecustomize, so set the config directly, not via env.
@@ -81,6 +89,12 @@ def pytest_configure(config):
         "chaos; also marked slow so tier-1's -m 'not slow' never runs "
         "it — scripts/check.sh has the chaos stage)",
     )
+    config.addinivalue_line(
+        "markers",
+        "leaves_threads: this test intentionally leaves background "
+        "threads running (escape hatch for the conftest thread-leak "
+        "guard)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -110,3 +124,68 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(pytest.mark.mid)
         else:
             item.add_marker(pytest.mark.quick)
+
+
+# ---------------------------------------------------------------------------
+# Thread-leak guard (r18 / graftlint PR): after each test, no NEW
+# non-daemon thread may survive, and the stack's own service threads —
+# the continuous decode loop ("decode-loop") and the scaling governor
+# ("fleet-scaler") — must have been stopped/joined by the test that
+# started them.  A short grace window absorbs threads that are mid-
+# teardown when the test body returns.  Watchdog "dispatch-*" threads
+# are exempt: a watchdog-cut hang ABANDONS its worker thread by design
+# (engine/faults.py), so those may outlive any hang-injection test.
+# Mark tests that intentionally background work with
+# @pytest.mark.leaves_threads.
+
+_LEAK_GRACE_S = 5.0
+_STRICT_NAMES = ("decode-loop", "fleet-scaler")
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    import threading
+    import time as _time
+
+    if request.node.get_closest_marker("leaves_threads") is not None:
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    def _leaked():
+        out = []
+        for t in threading.enumerate():
+            if t in before or not t.is_alive():
+                continue
+            if not t.daemon:
+                out.append(t)
+            elif any(t.name.startswith(n) for n in _STRICT_NAMES):
+                out.append(t)
+        return out
+
+    deadline = _time.time() + _LEAK_GRACE_S
+    leaked = _leaked()
+    while leaked and _time.time() < deadline:
+        _time.sleep(0.05)
+        leaked = _leaked()
+    assert not leaked, (
+        f"test leaked thread(s): {[t.name for t in leaked]} — stop/join "
+        f"every engine loop and governor the test started, or mark it "
+        f"@pytest.mark.leaves_threads"
+    )
+
+
+@pytest.fixture(autouse=True)
+def _locktrace_guard():
+    """LOCKTRACE=1 only: no lock-order inversion or held-across-
+    dispatch violation may be recorded during a test."""
+    if not locktrace.is_active():
+        yield
+        return
+    n0 = len(locktrace.violations())
+    yield
+    new = locktrace.violations()[n0:]
+    assert not new, (
+        "locktrace violations recorded during this test "
+        f"(docs/static-analysis.md): {new}"
+    )

@@ -497,4 +497,12 @@ def test_job_crash_smoke(tmp_path):
         assert "job_lines_total" in scrape
     finally:
         p2.terminate()
-        p2.wait(timeout=30)
+        try:
+            p2.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            # A CPU-starved box can stretch the SIGTERM drain past the
+            # window; drain latency is not this smoke's contract
+            # (exactly-once resume is), and a leaked half-drained
+            # server poisons every later test on the port/core.
+            p2.kill()
+            p2.wait(timeout=10)

@@ -1,0 +1,24 @@
+"""graftlint: repo-invariant static analysis (docs/static-analysis.md).
+
+Twelve PRs of review discipline, encoded as checkers.  The serving
+stack's correctness rests on conventions no general-purpose linter
+knows about — every device dispatch flows through ``dispatch_guard``,
+journal appends dominate consumer emits, policy code is clock-injected,
+knobs and metrics stay in sync with their docs and dashboards, and
+exceptions from guarded sites route through ``faults`` classification.
+This package enforces them with stdlib ``ast``/``tokenize`` only (the
+container has no network; nothing may be pip-installed).
+
+Usage::
+
+    python -m tools.graftlint mlmicroservicetemplate_tpu/
+    python -m tools.graftlint --json mlmicroservicetemplate_tpu/
+    python -m tools.graftlint --list-rules
+
+Waivers: ``# graftlint: <token>(<reason>)`` on the flagged line or the
+line directly above silences one rule at one site.  The reason is
+REQUIRED — an empty waiver is itself a finding.  Exit status is
+non-zero iff any unwaived finding remains.
+"""
+
+from .core import Finding, lint_paths, lint_source, rules  # noqa: F401

@@ -1,0 +1,43 @@
+"""CLI: ``python -m tools.graftlint [paths] [--json] [--rule ID]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import lint_paths, render_report, rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="repo-invariant static analysis "
+                    "(docs/static-analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=["mlmicroservicetemplate_tpu"],
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--rule", default=None, metavar="ID",
+                    help="run a single rule")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in rules():
+            print(f"{r.id:22s} waiver={getattr(r, 'waiver', r.id):12s} "
+                  f"{r.doc}")
+        return 0
+    if args.rule is not None and args.rule not in {r.id for r in rules()}:
+        print(f"graftlint: unknown rule {args.rule!r} "
+              f"(see --list-rules)", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths, only=args.rule)
+    report, code = render_report(findings, args.as_json)
+    print(report)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Rule registry.  Order is presentation order in ``--list-rules``."""
+
+from .dispatch_guard import DispatchGuardRule
+from .write_ahead import WriteAheadRule
+from .clock_injection import ClockInjectionRule
+from .knob_drift import KnobDriftRule
+from .metric_drift import MetricDriftRule
+from .exceptions import ExceptionDisciplineRule
+
+ALL_RULES = [
+    DispatchGuardRule,
+    WriteAheadRule,
+    ClockInjectionRule,
+    KnobDriftRule,
+    MetricDriftRule,
+    ExceptionDisciplineRule,
+]

@@ -1,0 +1,56 @@
+"""clock injection: policy code never reads the wall clock directly.
+
+``scheduler/policy.py`` and ``engine/supervisor.py`` hold pure,
+clock-injected policy (scaling decisions, restart windows, deadline
+expiry) precisely so tests pin their behavior without sleeping through
+real cooldowns — the r12/r17 test suites depend on it.  A direct
+``time.time()`` / ``time.monotonic()`` call in these files silently
+re-couples the policy to the wall clock.
+
+The injected-clock DEFAULT stays legal because it is a bare reference,
+not a call::
+
+    self._clock = clock if clock is not None else time.monotonic  # ok
+    now = time.monotonic()                                        # flagged
+
+Waive with ``# graftlint: clock(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Finding, dotted_name
+
+_FORBIDDEN = {"time.time", "time.monotonic", "time.perf_counter"}
+
+_SCOPED_FILES = (
+    "mlmicroservicetemplate_tpu/scheduler/policy.py",
+    "mlmicroservicetemplate_tpu/engine/supervisor.py",
+)
+
+
+class ClockInjectionRule:
+    id = "clock-injection"
+    waiver = "clock"
+    doc = ("time.time()/time.monotonic() calls are forbidden in "
+           "scheduler/policy.py and engine/supervisor.py — route "
+           "through the injected clock")
+
+    def applies(self, rel: str) -> bool:
+        return rel in _SCOPED_FILES
+
+    def check(self, ctx: Context) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _FORBIDDEN:
+                findings.append(Finding(
+                    self.id, ctx.rel, node.lineno,
+                    f"direct `{name}()` call in clock-injected policy "
+                    f"code — use the injected clock (`self._clock()`), "
+                    f"keeping the bare `{name}` default legal",
+                ))
+        return findings

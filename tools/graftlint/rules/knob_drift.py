@@ -1,0 +1,151 @@
+"""knob drift: every config knob stays validated and documented.
+
+``utils/config.py`` is the single source of truth for the service's
+env-var surface, but nothing used to force the rest of the repo to
+keep up: a knob added without a validator accepts garbage at boot
+instead of failing fast, and a knob missing from the README table is
+invisible to operators (r8's ``SEQ_BUCKETS`` routing bug went
+unnoticed partly because the interaction was undocumented).
+
+For every ``ServiceConfig`` field this repo-wide rule requires:
+
+1. **a validator** — the field is named in a ``field_validator``
+   decorator, or read (``self.<field>``) inside a ``model_validator``.
+   Exempt by construction: ``bool`` fields (pydantic coerces, there is
+   no range to check) and optional free-form strings (``str | None`` —
+   paths/URLs with no vocabulary).
+2. **a README knob-table row** — a markdown table row containing
+   `` `ENV_NAME` ``.
+3. **a docs mention** — ``ENV_NAME`` appears somewhere in README.md or
+   ``docs/*.md``.
+
+Findings anchor at the field's declaration line in config.py; waive
+with ``# graftlint: knob(<reason>)`` there.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..core import Context, Finding
+
+_CONFIG_REL = "mlmicroservicetemplate_tpu/utils/config.py"
+
+
+def _ann_str(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _config_fields(tree: ast.Module) -> list[tuple[str, str, int]]:
+    """(field, annotation, line) for every ServiceConfig field."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ServiceConfig":
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and not stmt.target.id.startswith("_")
+                ):
+                    out.append((
+                        stmt.target.id, _ann_str(stmt.annotation),
+                        stmt.lineno,
+                    ))
+    return out
+
+
+def _validated_fields(tree: ast.Module) -> set[str]:
+    """Fields covered by a field_validator decorator or read inside a
+    model_validator body."""
+    covered: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            dec_name = dec.func.attr if isinstance(
+                dec.func, ast.Attribute
+            ) else getattr(dec.func, "id", "")
+            if dec_name == "field_validator":
+                for arg in dec.args:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        covered.add(arg.value)
+            elif dec_name == "model_validator":
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"
+                    ):
+                        covered.add(sub.attr)
+    return covered
+
+
+def _validator_exempt(annotation: str) -> bool:
+    ann = annotation.replace(" ", "")
+    if ann == "bool":
+        return True
+    # Optional free-form strings: paths, URLs, raw prefix text.
+    return ann in ("str|None", "Optional[str]", "None|str")
+
+
+class KnobDriftRule:
+    id = "knob-drift"
+    waiver = "knob"
+    doc = ("every utils/config.py knob needs a validator, a README "
+           "knob-table row, and a docs mention")
+
+    def check_repo(self, root: Path, ctxs: dict[str, Context]
+                   ) -> list[Finding]:
+        ctx = ctxs.get(_CONFIG_REL)
+        if ctx is None:
+            path = root / _CONFIG_REL
+            if not path.exists():
+                return []
+            ctx = Context(root, path, path.read_text())
+            ctxs[_CONFIG_REL] = ctx  # waivers resolve in config.py
+        fields = _config_fields(ctx.tree)
+        covered = _validated_fields(ctx.tree)
+
+        readme = (root / "README.md")
+        readme_text = readme.read_text() if readme.exists() else ""
+        # A knob-table row is any markdown table line naming the knob
+        # in backticks (combined rows like `| \`A\` / \`B\` |` count).
+        table_text = "\n".join(
+            ln for ln in readme_text.splitlines() if ln.startswith("|")
+        )
+        docs_text = readme_text
+        docs_dir = root / "docs"
+        if docs_dir.is_dir():
+            for md in sorted(docs_dir.glob("*.md")):
+                docs_text += md.read_text()
+
+        findings: list[Finding] = []
+        for field, ann, line in fields:
+            env = field.upper()
+            if field not in covered and not _validator_exempt(ann):
+                findings.append(Finding(
+                    self.id, _CONFIG_REL, line,
+                    f"knob `{field}` ({env}) has no validator — a typo'd "
+                    f"value boots instead of failing fast",
+                ))
+            if f"`{env}`" not in table_text:
+                findings.append(Finding(
+                    self.id, _CONFIG_REL, line,
+                    f"knob `{env}` has no README knob-table row "
+                    f"(`| \\`{env}\\` | default | meaning |`)",
+                ))
+            if env not in docs_text:
+                findings.append(Finding(
+                    self.id, _CONFIG_REL, line,
+                    f"knob `{env}` is mentioned nowhere in README.md or "
+                    f"docs/*.md",
+                ))
+        return findings

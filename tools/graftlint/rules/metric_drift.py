@@ -1,0 +1,157 @@
+"""metric drift: the /metrics surface, its test and its dashboard agree.
+
+``utils/metrics.py`` declares the observability contract; the
+metrics-surface test and the Grafana dashboard are its two consumers.
+Three ways they historically drifted, each now a finding:
+
+1. **dashboard drift** — a series declared in utils/metrics.py that
+   appears nowhere in ``docs/grafana-serving.json``: it is invisible
+   to operators (the r11 dashboard predates five PRs of new series).
+2. **test drift** — ``tests/test_metrics_surface.py`` must keep its
+   declaration-introspection pin (`_declared_families` + the
+   "missing from /metrics" assertion).  While the pin is present every
+   declared series is checked against a real scrape automatically; if
+   someone deletes the pin, every series fires here.
+3. **inline metric creation** — ``Counter``/``Gauge``/``Histogram``
+   construction (or a ``prometheus_client`` import) outside
+   utils/metrics.py: series created elsewhere dodge both consumers.
+
+Plus a **label-cardinality bound**: ≤ 3 labels per family and no
+request-unique label names (``rid``/``request_id``/…) — a leaked
+label blows up Prometheus before any dashboard notices.
+
+Waive with ``# graftlint: metric(<reason>)`` at the declaration.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from ..core import Context, Finding, callee_name
+
+_METRICS_REL = "mlmicroservicetemplate_tpu/utils/metrics.py"
+_TEST_REL = "tests/test_metrics_surface.py"
+_GRAFANA_REL = "docs/grafana-serving.json"
+_FACTORIES = {"Counter", "Gauge", "Histogram", "Summary"}
+_MAX_LABELS = 3
+_UNBOUNDED_LABELS = {"rid", "request_id", "stream_id", "jid", "job_id"}
+
+
+def _declared_series(tree: ast.Module) -> list[tuple[str, list[str], int]]:
+    """(series_name, labels, line) for each module-level declaration."""
+    out = []
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        if callee_name(call) not in _FACTORIES:
+            continue
+        if not (call.args and isinstance(call.args[0], ast.Constant)):
+            continue
+        name = call.args[0].value
+        labels: list[str] = []
+        label_arg = call.args[2] if len(call.args) > 2 else None
+        for kw in call.keywords:
+            if kw.arg in ("labelnames", "labels"):
+                label_arg = kw.value
+        if isinstance(label_arg, (ast.List, ast.Tuple)):
+            labels = [
+                e.value for e in label_arg.elts
+                if isinstance(e, ast.Constant)
+            ]
+        out.append((str(name), labels, node.lineno))
+    return out
+
+
+class MetricDriftRule:
+    id = "metric-drift"
+    waiver = "metric"
+    doc = ("every utils/metrics.py series must reach the surface test "
+           "and the Grafana dashboard; no inline metric creation; "
+           "bounded label sets")
+
+    def check_repo(self, root: Path, ctxs: dict[str, Context]
+                   ) -> list[Finding]:
+        ctx = ctxs.get(_METRICS_REL)
+        if ctx is None:
+            path = root / _METRICS_REL
+            if not path.exists():
+                return []
+            ctx = Context(root, path, path.read_text())
+            ctxs[_METRICS_REL] = ctx
+        series = _declared_series(ctx.tree)
+
+        grafana_path = root / _GRAFANA_REL
+        grafana = grafana_path.read_text() if grafana_path.exists() else ""
+        test_path = root / _TEST_REL
+        test_text = test_path.read_text() if test_path.exists() else ""
+        has_pin = (
+            "_declared_families" in test_text
+            and "missing from /metrics" in test_text
+        )
+
+        findings: list[Finding] = []
+        if not has_pin:
+            findings.append(Finding(
+                self.id, _METRICS_REL, 1,
+                f"{_TEST_REL} lost its declaration-introspection pin "
+                f"(_declared_families + 'missing from /metrics') — "
+                f"series drift is no longer tested",
+            ))
+        for name, labels, line in series:
+            if name not in grafana:
+                findings.append(Finding(
+                    self.id, _METRICS_REL, line,
+                    f"series `{name}` appears nowhere in {_GRAFANA_REL} "
+                    f"— declared observability that no dashboard shows",
+                ))
+            if not has_pin and name not in test_text:
+                findings.append(Finding(
+                    self.id, _METRICS_REL, line,
+                    f"series `{name}` unchecked by {_TEST_REL}",
+                ))
+            if len(labels) > _MAX_LABELS:
+                findings.append(Finding(
+                    self.id, _METRICS_REL, line,
+                    f"series `{name}` has {len(labels)} labels (cap "
+                    f"{_MAX_LABELS}) — cardinality risk",
+                ))
+            bad = sorted(set(labels) & _UNBOUNDED_LABELS)
+            if bad:
+                findings.append(Finding(
+                    self.id, _METRICS_REL, line,
+                    f"series `{name}` labels {bad} look request-unique "
+                    f"— unbounded cardinality",
+                ))
+
+        # Inline metric creation outside utils/metrics.py.
+        for rel, fctx in ctxs.items():
+            if fctx is None or rel == _METRICS_REL:
+                continue
+            if not rel.startswith("mlmicroservicetemplate_tpu/"):
+                continue
+            for node in ast.walk(fctx.tree):
+                if (
+                    isinstance(node, ast.ImportFrom)
+                    and node.module == "prometheus_client"
+                ):
+                    findings.append(Finding(
+                        self.id, rel, node.lineno,
+                        "prometheus_client import outside "
+                        "utils/metrics.py — inline series dodge the "
+                        "surface test and the dashboard",
+                    ))
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FACTORIES
+                    and getattr(node.func.value, "id", "") == "metrics"
+                ):
+                    findings.append(Finding(
+                        self.id, rel, node.lineno,
+                        f"inline metrics.{node.func.attr}(...) outside "
+                        f"utils/metrics.py",
+                    ))
+        return findings

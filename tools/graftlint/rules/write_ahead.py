@@ -1,0 +1,102 @@
+"""write-ahead ordering: the journal learns before the consumer does.
+
+The durability contract (runtime/durability.py, jobs/store.py) is that
+after ``kill -9`` the journal covers EVERYTHING any client observed —
+reconnects dedup with zero double emission, job lines re-run at most
+the in-flight tail.  That holds only while every consumer-visible
+emission is dominated by its matching journal append *in the same
+function*: a crash in the gap between "append" and "emit" must err on
+the journal-knows-more side, never the client-knows-more side.
+
+Checked surfaces:
+
+- ``engine/streams.py`` and ``engine/fleet.py``: every
+  ``st.emit(...)`` call must be preceded (same function, earlier
+  line) by a journal append (``.tokens(…)`` / ``.done(…)`` /
+  ``.admit(…)`` — one-plus-argument calls, so ``future.done()``
+  probes never count);
+- ``jobs/store.py``: every assignment into ``job.results[...]`` (the
+  in-memory view GET results serves) must be preceded by a frame
+  ``._append(...)``.
+
+Waive with ``# graftlint: write-ahead(<reason>)`` — e.g. replay
+readers that materialize records already on disk.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Finding, callee_name
+
+_JOURNAL_ATTRS = {
+    "tokens", "done", "admit", "_append", "result",
+    # The loop's write-ahead terminal helper (idempotent j.done).
+    "_journal_done",
+}
+
+
+def _journal_lines(fn: ast.AST) -> list[int]:
+    out = []
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and callee_name(node) in _JOURNAL_ATTRS
+            and (node.args or node.keywords)
+        ):
+            out.append(node.lineno)
+    return out
+
+
+class WriteAheadRule:
+    id = "write-ahead"
+    waiver = "write-ahead"
+    doc = ("consumer-visible emits in streams.py/jobs must be dominated "
+           "by the matching journal append in the same function")
+
+    def applies(self, rel: str) -> bool:
+        return rel in (
+            "mlmicroservicetemplate_tpu/engine/streams.py",
+            "mlmicroservicetemplate_tpu/engine/fleet.py",
+            "mlmicroservicetemplate_tpu/jobs/store.py",
+        )
+
+    def check(self, ctx: Context) -> list[Finding]:
+        findings: list[Finding] = []
+        streams = not ctx.rel.endswith("store.py")
+        for node in ast.walk(ctx.tree):
+            if streams:
+                if not (
+                    isinstance(node, ast.Call)
+                    and callee_name(node) == "emit"
+                ):
+                    continue
+                what = "`.emit(...)`"
+            else:
+                # jobs/store.py: results become consumer-visible the
+                # moment they land in ``job.results``.
+                if not (
+                    isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and t.value.attr == "results"
+                        for t in node.targets
+                    )
+                ):
+                    continue
+                what = "`job.results[...] = ...`"
+            fn = ctx.enclosing_function(node)
+            if fn is None or fn.name == "emit":
+                continue  # the emit definition itself delivers, only
+            if any(ln < node.lineno for ln in _journal_lines(fn)):
+                continue
+            findings.append(Finding(
+                self.id, ctx.rel, node.lineno,
+                f"{what} in `{fn.name}` with no dominating journal "
+                f"append — a crash here leaves the client knowing more "
+                f"than the journal (waive: # graftlint: "
+                f"write-ahead(reason))",
+                end_line=getattr(node, "end_lineno", node.lineno),
+            ))
+        return findings
