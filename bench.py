@@ -185,6 +185,30 @@ async def bench_serving() -> "tuple[dict, object]":
             "host_pool": tier.stats() if tier is not None else None,
         }
 
+        # Warm-up economics (round 19): per-phase warm seconds, the
+        # executable-cache hit/miss counts and the process XLA compile
+        # totals — the warm-up table in BASELINE.md stops being
+        # hand-collected (docs/compilation.md).
+        from mlmicroservicetemplate_tpu.runtime.compile_cache import (
+            cache_stats,
+            compile_counters,
+            warm_stats,
+        )
+
+        comp = compile_counters()
+        warmup_block = {
+            "phases_s": warm_stats(),
+            "executable_cache": cache_stats(),
+            "xla_compiles": comp["count"],
+            "xla_compile_s": round(comp["seconds"], 3),
+            "host_prep": {
+                "double": getattr(cdl, "host_prep_double", False) if cdl else False,
+                "staged": getattr(cdl, "prep_staged", 0) if cdl else 0,
+                "hits": getattr(cdl, "prep_hits", 0) if cdl else 0,
+                "misses": getattr(cdl, "prep_misses", 0) if cdl else 0,
+            },
+        }
+
         return {
             "p50_ms": round(statistics.median(lats) * 1000, 3),
             "p99_ms": round(
@@ -203,6 +227,7 @@ async def bench_serving() -> "tuple[dict, object]":
             "dispatch_attribution": attribution,
             "decode_fusion": decode_fusion,
             "kv_tier": kv_tier,
+            "warmup": warmup_block,
         }, engine
     finally:
         await client.close()

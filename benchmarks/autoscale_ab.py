@@ -9,10 +9,15 @@ curve instead of the boot flag.  The cost is the scale-event latency
 (engine build + warm compile + probe), which this benchmark measures
 directly off ``/status.fleet.scaling``.
 
-Two arms over the same tiny-dims llama service (random-init weights,
-WARMUP=0 — scaling economics depend on dispatch structure, not
-weights; on 1 vCPU a real-dims warmup would dwarf the curve under
-test), same arrival curve:
+Two arms over the same tiny-dims llama service (random-init weights —
+scaling economics depend on dispatch structure, not weights).  Since
+r19 both arms boot with WARMUP=1 (sampling variants off): the boot
+warm is UNTIMED and populates the process-level ExecutableCache
+(docs/compilation.md), so the elastic arm's scale-up measures the
+production spawn fast-path — donor broadcast + cache-hit warm + probe
+— instead of a from-scratch compile of executables replica 0 never
+built (the r17 arm ran WARMUP=0, which is why its spawn paid a 262 s
+warm compile ON TOP of the serving core).  Same arrival curve:
 
 - **static-r1**:     FLEET_REPLICAS=1, no elastic bounds (the seed
                      behavior: MAX_STREAMS slots + a bounded queue,
@@ -116,16 +121,17 @@ async def run_arm(name: str, extra: dict, dev: dict) -> dict:
         "STREAM_CHUNK_TOKENS": "4",
         "MAX_STREAMS": "2",
         "MAX_STREAM_QUEUE": "4",
-        "WARMUP": "0",
+        "WARMUP": "1",
         "WARMUP_SAMPLING": "0",
         "REPLICAS": "1",
         **extra,
         **dev,
     }
     async with ServiceUnderTest(overrides) as s:
-        # Untimed warm round: WARMUP=0 leaves compiles on the request
-        # path; one stream absorbs them so the curve under test
-        # measures scheduling, not XLA (both arms identically).
+        # Untimed warm round: flushes any remaining request-path
+        # first-touch cost so the curve under test measures
+        # scheduling, not XLA (both arms identically; the boot warm
+        # already compiled the grid into the ExecutableCache).
         await _one(s.client, 0)
         print(f"[{name}] warm round done", file=sys.stderr)
         t0 = time.perf_counter()
@@ -145,7 +151,24 @@ async def run_arm(name: str, extra: dict, dev: dict) -> dict:
         sheds = sum(1 for r in rows if r["shed"])
         ttfts = [r["ttft"] for r in rows if r.get("ttft") is not None]
         recent = scaling.get("recent") or []
-        up_durs = [e["duration_s"] for e in recent if e["dir"] == "up"]
+        up_events = [e for e in recent if e["dir"] == "up"]
+        up_durs = [e["duration_s"] for e in up_events]
+        # Scale-up latency breakdown per event (ISSUE 14): where the
+        # spin-up wall went — engine build + donor broadcast, loop
+        # warm, probe, rebalance — and the XLA compiles it paid.
+        # With the fleet-shared executable cache the second spawn's
+        # xla_compiles is 0 and warm_s collapses to dispatch time.
+        breakdowns = [
+            {"cause": e.get("cause"), "replica": e.get("replica"),
+             "duration_s": e.get("duration_s"), **e.get("breakdown", {})}
+            for e in up_events
+        ]
+        status_compile = None
+        try:
+            full_status = await (await s.client.get("/status")).json()
+            status_compile = full_status.get("compile")
+        except Exception:
+            pass
         return {
             "arm": name,
             "offered": len(rows),
@@ -165,6 +188,8 @@ async def run_arm(name: str, extra: dict, dev: dict) -> dict:
             "scale_up_latency_s": (
                 round(max(up_durs), 3) if up_durs else None
             ),
+            "scale_up_breakdown": breakdowns,
+            "compile": status_compile,
         }
 
 
@@ -200,6 +225,17 @@ async def main() -> None:
             f"| {r['scale_up_latency_s']} |",
             file=sys.stderr,
         )
+        for b in r.get("scale_up_breakdown") or []:
+            print(
+                f"    up:{b.get('cause')} r{b.get('replica')}: "
+                f"total {b.get('duration_s')}s = build "
+                f"{b.get('build_s')}s + warm {b.get('warm_s')}s + "
+                f"probe {b.get('probe_s')}s + rebalance "
+                f"{b.get('rebalance_s')}s "
+                f"({b.get('xla_compiles')} XLA compiles, "
+                f"{b.get('compile_s')}s compiling)",
+                file=sys.stderr,
+            )
         print(json.dumps({**r, "backend": backend,
                           "wave": WAVE, "lull_s": LULL_S}))
 

@@ -1406,6 +1406,13 @@ async def handle_status(request: web.Request) -> web.Response:
             "window_early_exits": getattr(cdl, "window_early_exits", 0),
             "chunk_dispatches": cdl.chunk_dispatches,
             "tokens_emitted": getattr(cdl, "tokens_emitted", 0),
+            # Double-buffered host prep (HOST_PREP_DOUBLE;
+            # docs/compilation.md): staged plans and how many were
+            # consumed as-is vs rolled back and re-prepped inline.
+            "host_prep_double": getattr(cdl, "host_prep_double", False),
+            "prep_staged": getattr(cdl, "prep_staged", 0),
+            "prep_hits": getattr(cdl, "prep_hits", 0),
+            "prep_misses": getattr(cdl, "prep_misses", 0),
             # Per-site host-sync counts (the quantity DECODE_WINDOW
             # divides); the fusion A/B reads the chunk+fetch deltas.
             "dispatch_counts": {
@@ -1459,6 +1466,11 @@ async def handle_status(request: web.Request) -> web.Response:
     if jobs is not None:
         # Bulk inference lane (JOBS_ENABLED; docs/bulk-inference.md).
         body["jobs"] = jobs.stats()
+    if hasattr(batcher, "compile_status"):
+        # Compile economics (docs/compilation.md): executable-cache
+        # hit/miss/insert counts, per-phase warm seconds, process XLA
+        # compile totals — what a fleet spawn or restart actually paid.
+        body["compile"] = batcher.compile_status()
     tr = tracing.tracer()
     body["observability"] = {
         "trace": tr is not None,

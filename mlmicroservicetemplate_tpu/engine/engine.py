@@ -140,8 +140,15 @@ class InferenceEngine:
             # continuous-batching loop pipelines chunk dispatches and
             # holds the previous state's `done`/token buffers across the
             # next call — donation would invalidate them mid-flight.
-            self._gen_chunk = jax.jit(
-                bundle.generate_chunk_fn, static_argnums=(2, 3)
+            # Every wrapper below routes through the process-level
+            # ExecutableCache (runtime/compile_cache.py): a second
+            # engine over the SAME bundle + placement (fleet spawns,
+            # supervised rebuilds) shares the first's jitted wrappers
+            # and performs zero XLA compiles at warm.
+            self._gen_chunk = self._shared_jit(
+                "gen_chunk",
+                lambda: jax.jit(bundle.generate_chunk_fn,
+                                static_argnums=(2, 3)),
             )
 
             # encode + cache init + first decode chunk fused into ONE
@@ -155,7 +162,9 @@ class InferenceEngine:
                 state = bundle.init_state_fn(p, enc, mask, max_len, sample=sp)
                 return bundle.generate_chunk_fn(p, state, n_steps, sample)
 
-            self._start = jax.jit(start, static_argnums=(4, 5, 6))
+            self._start = self._shared_jit(
+                "start", lambda: jax.jit(start, static_argnums=(4, 5, 6))
+            )
 
             # Non-streaming generate: encode + init + a done-aware
             # while_loop of chunk scans, still ONE dispatch.  An
@@ -190,7 +199,9 @@ class InferenceEngine:
                 state = lax.while_loop(cond, body, state)
                 return state.tokens, state.pos.max()
 
-            self._full = jax.jit(full, static_argnums=(5, 6, 7))
+            self._full = self._shared_jit(
+                "full", lambda: jax.jit(full, static_argnums=(5, 6, 7))
+            )
 
             # Speculative decoding (SPEC_DECODE=ngram, models/spec.py):
             # greedy streams draft spec_k tokens by prompt-lookup and
@@ -218,11 +229,14 @@ class InferenceEngine:
                     ss = bundle.init_spec_fn(state, ids, mask)
                     return bundle.spec_chunk_fn(p, ss, n_verify, spec_k, sample)
 
-                self._spec_start = jax.jit(
-                    spec_start, static_argnums=(4, 5, 6, 7)
+                self._spec_start = self._shared_jit(
+                    "spec_start",
+                    lambda: jax.jit(spec_start, static_argnums=(4, 5, 6, 7)),
                 )
-                self._spec_chunk = jax.jit(
-                    bundle.spec_chunk_fn, static_argnums=(2, 3, 4)
+                self._spec_chunk = self._shared_jit(
+                    "spec_chunk",
+                    lambda: jax.jit(bundle.spec_chunk_fn,
+                                    static_argnums=(2, 3, 4)),
                 )
 
                 # Non-streaming greedy batches take the speculative
@@ -265,7 +279,10 @@ class InferenceEngine:
                     ss = lax.while_loop(cond, body, ss)
                     return ss.base.tokens, ss.base.pos.max()
 
-                self._full_spec = jax.jit(full_spec, static_argnums=(5, 6, 7))
+                self._full_spec = self._shared_jit(
+                    "full_spec",
+                    lambda: jax.jit(full_spec, static_argnums=(5, 6, 7)),
+                )
 
             # Block-paged KV (PAGED_KV=1, decoder families): the
             # continuous loop's KV lives in a pool of KV_BLOCK_SIZE-
@@ -447,8 +464,10 @@ class InferenceEngine:
                     state = bundle.init_state_fn(p2, enc, mask, max_len, sample=sp)
                     return bundle.generate_chunk_fn(p2, state, n_steps, sample)
 
-                self._start_prefixed = jax.jit(
-                    start_prefixed, static_argnums=(5, 6, 7)
+                self._start_prefixed = self._shared_jit(
+                    "start_prefixed",
+                    lambda: jax.jit(start_prefixed,
+                                    static_argnums=(5, 6, 7)),
                 )
 
                 # Batched-wave variant: N same-(prefix-bucket,
@@ -471,8 +490,10 @@ class InferenceEngine:
                     state = bundle.init_state_fn(p2, enc, mask, max_len, sample=sp)
                     return bundle.generate_chunk_fn(p2, state, n_steps, sample)
 
-                self._start_prefixed_wave = jax.jit(
-                    start_prefixed_wave, static_argnums=(5, 6, 7)
+                self._start_prefixed_wave = self._shared_jit(
+                    "start_prefixed_wave",
+                    lambda: jax.jit(start_prefixed_wave,
+                                    static_argnums=(5, 6, 7)),
                 )
                 self._slice_prefix: dict[int, Any] = {}
 
@@ -499,11 +520,15 @@ class InferenceEngine:
                             p2, ss, n_verify, spec_k, sample
                         )
 
-                    self._spec_start_prefixed = jax.jit(
-                        spec_start_prefixed, static_argnums=(6, 7, 8, 9)
+                    self._spec_start_prefixed = self._shared_jit(
+                        "spec_start_prefixed",
+                        lambda: jax.jit(spec_start_prefixed,
+                                        static_argnums=(6, 7, 8, 9)),
                     )
         else:
-            self._forward = jax.jit(bundle.forward)
+            self._forward = self._shared_jit(
+                "forward", lambda: jax.jit(bundle.forward)
+            )
             self.spec_enabled = False
             self.spec_sampled = False
             self.prefix_cache = None
@@ -737,6 +762,17 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     # fault tolerance
+
+    def _shared_jit(self, kind: str, build, statics: tuple = ()):
+        """Route one jit-wrapper construction through the process-level
+        ExecutableCache (runtime/compile_cache.py): engines over the
+        same bundle + placement share wrappers, so fleet spawns and
+        supervised rebuilds re-trace and re-compile nothing."""
+        from ..runtime.compile_cache import shared_executable
+
+        return shared_executable(
+            kind, self.bundle, self.replicas, build, statics
+        )
 
     def dispatch_guard(self, site: str, fn):
         """Run one device-dispatch callable under the fault injector
@@ -1026,7 +1062,9 @@ class InferenceEngine:
                     "v": [cut(c, r) for c in st.cache_v],
                 }
 
-            self._slice_prefix[p_len] = jax.jit(slc)
+            self._slice_prefix[p_len] = self._shared_jit(
+                "slice_prefix", lambda: jax.jit(slc), statics=(p_len,)
+            )
         return self._slice_prefix[p_len](state, np.int32(row))
 
     def generate_stream(self, feats: dict) -> Iterator[np.ndarray]:
@@ -1252,6 +1290,12 @@ class InferenceEngine:
         seconds spent; call at startup, before readiness flips true."""
         import jax
 
+        from ..runtime.compile_cache import warm_phase
+
+        with warm_phase(self.bundle.name, "engine"):
+            return self._warmup_inner(jax)
+
+    def _warmup_inner(self, jax) -> float:
         t0 = time.monotonic()
         mult = self._pad_multiple()
         batch_buckets = [b for b in self.batch_buckets if b % mult == 0 and b >= mult]

@@ -65,7 +65,7 @@ from ..utils import metrics
 log = logging.getLogger(__name__)
 
 SITES = ("prefill", "prefill_chunk", "chunk", "fetch", "batch", "grow",
-         "handoff", "swap", "*")
+         "handoff", "swap", "prep", "*")
 KINDS = ("transient", "fatal", "hang", "oob")
 
 

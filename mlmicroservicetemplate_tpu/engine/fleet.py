@@ -650,17 +650,29 @@ class ReplicaFleet:
             rep.admission.set_budget(share)
 
     def _record_scale(self, direction: str, cause: str, rid: int,
-                      t0: float) -> None:
+                      t0: float, breakdown: dict | None = None) -> None:
         dt = time.monotonic() - t0
         self._last_scale_duration_s = dt
         metrics.FLEET_SCALE_EVENTS.labels(self.model, direction, cause).inc()
         metrics.FLEET_SCALE_DURATION.labels(self.model, direction).observe(dt)
         key = f"{direction}:{cause}"
         self._scale_counts[key] = self._scale_counts.get(key, 0) + 1
-        self._scale_events.append({
+        event = {
             "dir": direction, "cause": cause, "replica": rid,
             "duration_s": round(dt, 3),
-        })
+        }
+        if breakdown:
+            # Scale-up latency attribution (benchmarks/autoscale_ab.py,
+            # BASELINE.md): where the spin-up wall went — engine build
+            # + donor broadcast, loop warm, probe dispatch, budget
+            # rebalance — plus the XLA compiles the whole event paid
+            # (zero once a sibling replica populated the
+            # ExecutableCache; docs/compilation.md).
+            event["breakdown"] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in breakdown.items()
+            }
+        self._scale_events.append(event)
 
     def _probe(self, rep: Replica) -> None:
         """One real dispatch through the spawned engine BEFORE it joins
@@ -697,6 +709,10 @@ class ReplicaFleet:
         admitted Replica, or None when the spawn failed (existing
         traffic is untouched either way: the spawn was never
         routable)."""
+        from ..runtime.compile_cache import (
+            CompileWindow,
+            note_warm_phase,
+        )
         from .engine import InferenceEngine
 
         t0 = time.monotonic()
@@ -707,15 +723,42 @@ class ReplicaFleet:
         per_cfg = self._share_cfg(len(self.live_replicas()) + 1)
         self._spawning = {"replica": rid, "cause": cause}
         self._refresh_gauges()
+        # Spin-up latency breakdown (compile vs probe vs rebalance —
+        # ISSUE 14): each phase timed, plus the XLA compiles the whole
+        # spawn paid via jax.monitoring.  With the ExecutableCache
+        # populated by any sibling replica, xla_compiles is ZERO and
+        # warm_s collapses to dispatch time (the second-spawn pin in
+        # tests/test_compile_cache.py).
+        breakdown: dict = {}
         try:
-            eng = InferenceEngine(
-                donor_eng.bundle, per_cfg, replicas=donor_eng.replicas,
-                replica_id=rid, donor_params=donor_eng.params,
-            )
-            rep = self._wire_replica(eng, per_cfg)
-            self._share_tiers(rep)
-            rep.cdl.warm()
-            self._probe(rep)
+            with CompileWindow() as cw:
+                t = time.monotonic()
+                eng = InferenceEngine(
+                    donor_eng.bundle, per_cfg, replicas=donor_eng.replicas,
+                    replica_id=rid, donor_params=donor_eng.params,
+                )
+                rep = self._wire_replica(eng, per_cfg)
+                self._share_tiers(rep)
+                breakdown["build_s"] = time.monotonic() - t
+                note_warm_phase(self.model, "spawn_build",
+                                breakdown["build_s"])
+                t = time.monotonic()
+                # Fast warm (docs/compilation.md): the donor's loop
+                # already populated the ExecutableCache, so the spawn
+                # skips the warm-dispatch grid and adopts the donor's
+                # RTT calibration; no donor (first boot) = full warm.
+                rep.cdl.warm_spawn(donor.cdl if donor is not None
+                                   else None)
+                breakdown["warm_s"] = time.monotonic() - t
+                note_warm_phase(self.model, "spawn_warm",
+                                breakdown["warm_s"])
+                t = time.monotonic()
+                self._probe(rep)
+                breakdown["probe_s"] = time.monotonic() - t
+                note_warm_phase(self.model, "spawn_probe",
+                                breakdown["probe_s"])
+            breakdown["compile_s"] = cw.seconds
+            breakdown["xla_compiles"] = cw.compiles
         except Exception as e:
             # A mid-scale-up death (probe fault, OOM at warm) aborts
             # JUST the spawn: nothing was routed here yet, so existing
@@ -729,6 +772,7 @@ class ReplicaFleet:
             self._refresh_gauges()
             return None
         self._spawning = None
+        t = time.monotonic()
         with self._lock:
             if replace is not None and replace in self.replicas:
                 # Rejoin: the rebuilt replica takes the corpse's seat
@@ -742,7 +786,8 @@ class ReplicaFleet:
             if reuse_id is None:
                 self._next_id = max(self._next_id, rid + 1)
         self._rebalance()
-        self._record_scale("up", cause, rid, t0)
+        breakdown["rebalance_s"] = time.monotonic() - t
+        self._record_scale("up", cause, rid, t0, breakdown)
         self._refresh_gauges()
         log.info(
             "scale-up: replica %d admitted (cause=%s, params=%s, "
@@ -935,8 +980,16 @@ class ReplicaFleet:
     # -- lifecycle -----------------------------------------------------
 
     def warm(self) -> None:
+        """Boot warm: replica 0 pays the full warm (compiling every
+        executable INTO the shared cache); replicas 1..R-1 fast-warm
+        from it — same λScale economics as a live spawn."""
+        donor = None
         for rep in self.replicas:
-            rep.cdl.warm()
+            if donor is None:
+                rep.cdl.warm()
+                donor = rep
+            else:
+                rep.cdl.warm_spawn(donor.cdl)
 
     def begin_drain(self) -> None:
         for rep in self.replicas:

@@ -401,6 +401,27 @@ class ContinuousDecodeLoop:
         self.prefetch_blocks_total = 0
         self.prefetch_blocks_live = 0
         self.host_prefix_promotes = 0
+        # Double-buffered host prep (HOST_PREP_DOUBLE, default on;
+        # docs/compilation.md): with chunk N in flight, iteration
+        # N+1's host-side dispatch prep — the paged growth pass (block
+        # grants + table assembly) and the table's host→device upload
+        # — is STAGED immediately after N's dispatch, overlapping N's
+        # device compute and its RTT-long fetch instead of serializing
+        # between dispatches.  The staged plan is consumed at the next
+        # dispatch only if the loop state it derived from is
+        # bit-identical (same tenants, same dispatched-step cursors,
+        # same table bytes, same window); anything that moved rolls
+        # the staged grants back and re-preps inline — so the
+        # dispatched table is identical either way and token identity
+        # is structural, not probabilistic.  Contiguous mode has no
+        # growth/table prep to stage; the knob is a no-op there.
+        self.host_prep_double = bool(
+            getattr(cfg, "host_prep_double", True)
+        )
+        self._staged_prep: dict | None = None
+        self.prep_staged = 0
+        self.prep_hits = 0
+        self.prep_misses = 0
         self.decode_window = max(1, int(getattr(cfg, "decode_window", 1) or 1))
         if self.decode_window > 1:
             if self.spec:
@@ -979,6 +1000,13 @@ class ContinuousDecodeLoop:
                 # decode cadence by at most its own compute.
                 advanced = self._advance_swapins()
                 advanced = self._advance_prefill() or advanced
+                # Double-buffered host prep: with the chunk just
+                # dispatched still in flight (its fetch below blocks
+                # for ~RTT), stage the NEXT dispatch's growth plan +
+                # table upload now — host prep rides the device's
+                # compute window instead of the gap between dispatches.
+                if dispatched:
+                    self._stage_host_prep()
                 if len(self._inflight_chunks) > self.chain_depth:
                     self._deliver_oldest()
                 elif self._inflight_chunks and not dispatched:
@@ -1046,6 +1074,7 @@ class ContinuousDecodeLoop:
                 # A failed dispatch may have already consumed (donated)
                 # the state buffers — rebuild lazily on next admission.
                 self._state = None
+                self._staged_prep = None
                 self._inflight_chunks.clear()
                 self.sampled_slots.clear()
                 # Restart budget exhausted: the engine is declared
@@ -1055,6 +1084,7 @@ class ContinuousDecodeLoop:
                 if self.supervisor is not None and self.supervisor.failed:
                     self._stop.set()
         # Shutdown: end every remaining consumer cleanly.
+        self._staged_prep = None  # slot frees below return every block
         self._drain_swapouts()  # free demotion refs; ledger stays exact
         if self.paged:
             # Demotions still queued on the engine never gather now:
@@ -1204,6 +1234,10 @@ class ContinuousDecodeLoop:
         from .faults import DispatchTimeoutError
 
         self._swap_hold = isinstance(exc, DispatchTimeoutError)
+        # A staged host-prep plan names blocks of the pools being torn
+        # down: discard it plain (each stream's checkpoint/release
+        # below returns its WHOLE block list, staged grants included).
+        self._staged_prep = None
         recovered = 0
         for st, *_ in self._pending_admissions:
             recovered += self._checkpoint_requeue(st)
@@ -1402,6 +1436,9 @@ class ContinuousDecodeLoop:
         crash costs latency, never output."""
         self.dead = True
         self._stop.set()
+        # Staged host prep dies with the corpse: the stream releases
+        # below return every block, staged grants included.
+        self._staged_prep = None
         harvested: list[_Stream] = []
 
         def h(st: _Stream) -> None:
@@ -2075,19 +2112,35 @@ class ContinuousDecodeLoop:
         read from other threads as a snapshot)."""
         return sum(max(0, j.L - j.consumed) for j in list(self._prefilling))
 
+    def _shared_jit(self, kind: str, build, statics: tuple = ()):
+        """Loop-owned executables route through the engine's
+        process-level ExecutableCache too (runtime/compile_cache.py):
+        every replica's loop shares one wrapper per (bundle, kind,
+        statics, placement), so a spawned replica's warm() re-traces
+        nothing.  Duck-typed test engines without the helper keep
+        private wrappers."""
+        shared = getattr(self.engine, "_shared_jit", None)
+        if shared is None:
+            return build()
+        return shared(kind, build, statics)
+
     def _prefill_fn(self):
         if self._prefill_jit is None:
             import jax
 
-            self._prefill_jit = jax.jit(self.engine.bundle.prefill_chunk_fn)
+            self._prefill_jit = self._shared_jit(
+                "prefill_chunk",
+                lambda: jax.jit(self.engine.bundle.prefill_chunk_fn),
+            )
         return self._prefill_jit
 
     def _paged_prefill_fn(self):
         if self._paged_prefill_jit is None:
             import jax
 
-            self._paged_prefill_jit = jax.jit(
-                self.engine.bundle.paged_prefill_chunk_fn
+            self._paged_prefill_jit = self._shared_jit(
+                "paged_prefill_chunk",
+                lambda: jax.jit(self.engine.bundle.paged_prefill_chunk_fn),
             )
         return self._paged_prefill_jit
 
@@ -2095,8 +2148,10 @@ class ContinuousDecodeLoop:
         if self._empty_state_jit is None:
             import jax
 
-            self._empty_state_jit = jax.jit(
-                self.engine.bundle.empty_state_fn, static_argnums=(1, 2, 3)
+            self._empty_state_jit = self._shared_jit(
+                "empty_state",
+                lambda: jax.jit(self.engine.bundle.empty_state_fn,
+                                static_argnums=(1, 2, 3)),
             )
         return self._empty_state_jit
 
@@ -2123,7 +2178,9 @@ class ContinuousDecodeLoop:
                     key_valid=st.key_valid.at[:, :p_len].set(1),
                 )
 
-            self._seed_prefix_fns[p_len] = jax.jit(seed)
+            self._seed_prefix_fns[p_len] = self._shared_jit(
+                "seed_prefix", lambda: jax.jit(seed), statics=(p_len,)
+            )
         return self._seed_prefix_fns[p_len](state, pkv)
 
     def _paged_handoff_fn(self):
@@ -2159,7 +2216,9 @@ class ContinuousDecodeLoop:
                     ),
                 )
 
-            self._paged_handoff = jax.jit(handoff)
+            self._paged_handoff = self._shared_jit(
+                "paged_handoff", lambda: jax.jit(handoff)
+            )
         return self._paged_handoff
 
     def _chunked_prefix_usable(self, L: int):
@@ -2631,9 +2690,10 @@ class ContinuousDecodeLoop:
                 eng.params, ids, mask, sp, eng.max_decode_len, eng.chunk_tokens, False
             )
             if self.spec:
-                template = jax.jit(eng.bundle.init_spec_fn)(
-                    template, ids, mask
-                )
+                template = self._shared_jit(
+                    "init_spec_template",
+                    lambda: jax.jit(eng.bundle.init_spec_fn),
+                )(template, ids, mask)
         if self.paged:
             self._build_empty_paged(template)
             return
@@ -2837,7 +2897,9 @@ class ContinuousDecodeLoop:
                     )
                     return type(batched)(base=base, history=hist)
 
-                self._insert = jax.jit(insert_spec)
+                self._insert = self._shared_jit(
+                    "insert_spec", lambda: jax.jit(insert_spec)
+                )
             else:
                 def insert(batched, single, slot, row):
                     return jax.tree.map(
@@ -2849,7 +2911,9 @@ class ContinuousDecodeLoop:
                 # reference buffers of the pre-insert state (their
                 # toks/done fetch later); donation would invalidate
                 # them mid-flight.
-                self._insert = jax.jit(insert)
+                self._insert = self._shared_jit(
+                    "insert", lambda: jax.jit(insert)
+                )
         return self._insert
 
     # -- paged executables ---------------------------------------------
@@ -2858,8 +2922,10 @@ class ContinuousDecodeLoop:
         if self._paged_chunk is None:
             import jax
 
-            self._paged_chunk = jax.jit(
-                self.engine.bundle.paged_chunk_fn, static_argnums=(3, 4)
+            self._paged_chunk = self._shared_jit(
+                "paged_chunk",
+                lambda: jax.jit(self.engine.bundle.paged_chunk_fn,
+                                static_argnums=(3, 4)),
             )
         return self._paged_chunk
 
@@ -2931,7 +2997,11 @@ class ContinuousDecodeLoop:
                     ),
                 )
 
-            self._paged_insert = jax.jit(insert, static_argnums=(5, 6))
+            self._paged_insert = self._shared_jit(
+                "paged_insert",
+                lambda: jax.jit(insert, static_argnums=(5, 6)),
+                statics=(bs,),
+            )
         return self._paged_insert
 
     def _gather_prefix(self, p_len: int, block_ids) -> Any:
@@ -2963,7 +3033,10 @@ class ContinuousDecodeLoop:
                     "v": [entry(c) for c in state.cache_v],
                 }
 
-            self._gather_prefix_fns[p_len] = jax.jit(gather)
+            self._gather_prefix_fns[p_len] = self._shared_jit(
+                "gather_prefix", lambda: jax.jit(gather),
+                statics=(p_len, bs),
+            )
         blocks = jnp.asarray(np.asarray(block_ids, np.int32))
         return self._gather_prefix_fns[p_len](self._state, blocks)
 
@@ -3153,7 +3226,9 @@ class ContinuousDecodeLoop:
                     lambda pool: pool[ids], (state.cache_k, state.cache_v)
                 )
 
-            self._swap_gather_jit = jax.jit(gather)
+            self._swap_gather_jit = self._shared_jit(
+                "swap_gather", lambda: jax.jit(gather)
+            )
         return self._swap_gather_jit
 
     def _swap_scatter_fn(self):
@@ -3174,7 +3249,9 @@ class ContinuousDecodeLoop:
                 ck, cv = jax.tree.unflatten(treedef, new)
                 return state._replace(cache_k=ck, cache_v=cv)
 
-            self._swap_scatter_jit = jax.jit(scatter)
+            self._swap_scatter_jit = self._shared_jit(
+                "swap_scatter", lambda: jax.jit(scatter)
+            )
         return self._swap_scatter_jit
 
     def _gather_to_pending(self, block_ids: list[int]):
@@ -3811,10 +3888,11 @@ class ContinuousDecodeLoop:
         )
         return live, waiting
 
-    def _pick_window(self) -> int:
+    def _pick_window(self, preview: bool = False) -> int:
         """Fused-window depth for the NEXT dispatch: the governor's
         class policy, clamped to the chunks any live stream still
-        needs beyond what is already in flight."""
+        needs beyond what is already in flight.  ``preview`` stages
+        without governor side effects (double-buffered prep)."""
         if self.decode_window <= 1 or self.spec:
             return 1
         chunk = self.engine.chunk_tokens
@@ -3828,7 +3906,8 @@ class ContinuousDecodeLoop:
             default=0,
         )
         interactive_live, interactive_waiting = self.interactive_load()
-        return self._window_gov.pick(
+        fn = self._window_gov.preview if preview else self._window_gov.pick
+        return fn(
             max_chunks=-(-need // chunk),
             interactive_live=interactive_live,
             interactive_waiting=interactive_waiting,
@@ -3842,14 +3921,17 @@ class ContinuousDecodeLoop:
 
         if self.paged:
             if self._paged_window_jit is None:
-                self._paged_window_jit = jax.jit(
-                    self.engine.bundle.paged_window_fn,
-                    static_argnums=(3, 4, 5),
+                self._paged_window_jit = self._shared_jit(
+                    "paged_window",
+                    lambda: jax.jit(self.engine.bundle.paged_window_fn,
+                                    static_argnums=(3, 4, 5)),
                 )
             return self._paged_window_jit
         if self._window_jit is None:
-            self._window_jit = jax.jit(
-                self.engine.bundle.window_fn, static_argnums=(2, 3, 4)
+            self._window_jit = self._shared_jit(
+                "window",
+                lambda: jax.jit(self.engine.bundle.window_fn,
+                                static_argnums=(2, 3, 4)),
             )
         return self._window_jit
 
@@ -3909,6 +3991,106 @@ class ContinuousDecodeLoop:
         if grew and self.admission is not None:
             self.admission.note_pool()
 
+    # -- double-buffered host prep (HOST_PREP_DOUBLE) -------------------
+
+    def _stage_host_prep(self) -> None:
+        """Stage iteration N+1's host prep while N is in flight: run
+        the paged growth pass (block grants + table assembly) NOW and
+        start the table's host→device upload, so the next dispatch's
+        host work collapses to a validity check.  Growth here is the
+        SAME ``_grow_for_dispatch`` the inline path runs — a dry pool
+        checkpoints exactly as it would one iteration later (the
+        in-flight chunk's undelivered tokens are not part of any
+        checkpoint, so resume stays token-identical).  The upload runs
+        under the ``prep`` dispatch site: measured in
+        ``dispatch_host_seconds{site="prep"}``, watchdogged, and a
+        chaos target (``rN:prep:fatal@K`` kills a replica mid-staging
+        — the recovery/evacuation paths discard the staged plan)."""
+        self._rollback_staged_prep()
+        if not (self.host_prep_double and self.paged and self.active):
+            return
+        if not self._work_remains():
+            return
+        eng = self.engine
+        w = self._pick_window(preview=True)
+        t0 = time.perf_counter()
+        steps0 = dict(self._dispatched_steps)
+        self._grow_for_dispatch(w)
+        if not self.active:  # every row checkpointed on a dry pool
+            return
+        deltas = {
+            slot: self._dispatched_steps.get(slot, 0) - steps0.get(slot, 0)
+            for slot in self.active
+        }
+        table_np = self._table.copy()
+        # Growth + table assembly host seconds land on the prep site
+        # too (the guarded upload below notes its own share).
+        eng._note_dispatch("prep", time.perf_counter() - t0, None)
+        import jax.numpy as jnp
+
+        with eng._lock:
+            table_dev = eng.dispatch_guard(
+                "prep", lambda: jnp.asarray(table_np)
+            )
+        self._staged_prep = {
+            "w": w,
+            "tenants": dict(self.active),
+            "steps": dict(self._dispatched_steps),
+            "deltas": deltas,
+            "table_np": table_np,
+            "table": table_dev,
+        }
+        self.prep_staged += 1
+
+    def _rollback_staged_prep(self) -> None:
+        """Return a stale staged plan's grants: subtract each still-
+        live tenant's staged step delta and trim the over-granted tail
+        blocks (mirrors ``_reconcile_window``).  Tenants that left the
+        active set since staging released their whole block list
+        already — nothing to return for them."""
+        staged, self._staged_prep = self._staged_prep, None
+        if staged is None:
+            return
+        trimmed = False
+        for slot, st in staged["tenants"].items():
+            if self.active.get(slot) is not st or st.blocks is None:
+                continue
+            delta = staged["deltas"].get(slot, 0)
+            if not delta:
+                continue
+            steps = max(0, self._dispatched_steps.get(slot, 0) - delta)
+            self._dispatched_steps[slot] = steps
+            need = min(st.s_base + steps, st.s_base + st.budget)
+            trimmed |= bool(st.blocks.trim(need))
+            n = len(st.blocks.ids)
+            self._table[slot, :n] = st.blocks.ids
+            self._table[slot, n:] = self.pool.num_blocks
+        if trimmed and self.admission is not None:
+            self.admission.note_pool()
+
+    def _consume_staged_prep(self, w: int):
+        """The staged device table for this dispatch, or None.  Valid
+        ONLY when the loop state still matches the staged snapshot
+        bit-for-bit — same window, same tenants (by identity), same
+        dispatched-step cursors, same table bytes.  A mismatch rolls
+        the staged grants back so the inline re-prep starts from the
+        exact pre-staging state."""
+        staged = self._staged_prep
+        if staged is None:
+            return None
+        if (
+            staged["w"] == w
+            and staged["tenants"] == dict(self.active)
+            and staged["steps"] == dict(self._dispatched_steps)
+            and np.array_equal(staged["table_np"], self._table)
+        ):
+            self._staged_prep = None
+            self.prep_hits += 1
+            return staged["table"]
+        self.prep_misses += 1
+        self._rollback_staged_prep()
+        return None
+
     def _dispatch_chunk(self) -> None:
         eng = self.engine
         w = self._pick_window()
@@ -3934,16 +4116,22 @@ class ContinuousDecodeLoop:
 
     def _dispatch_chunk_inner(self, eng, w: int = 1) -> None:
         if self.paged:
-            # A fused window pre-provisions blocks for its whole depth
-            # up front: one growth pass per window, not per chunk.
-            self._grow_for_dispatch(w)
-            if not self.active:  # every row checkpointed on a dry pool
-                return
+            # Double-buffered prep: the staged plan (growth already
+            # ran, table already uploading) is used when still valid;
+            # otherwise fall back to the inline pass.  A fused window
+            # pre-provisions blocks for its whole depth up front: one
+            # growth pass per window, not per chunk — either way.
+            table = self._consume_staged_prep(w)
+            if table is None:
+                self._grow_for_dispatch(w)
+                if not self.active:  # every row checkpointed, dry pool
+                    return
             use_sample = bool(self.sampled_slots)
             import jax.numpy as jnp
 
             with eng._lock:
-                table = jnp.asarray(self._table)
+                if table is None:
+                    table = jnp.asarray(self._table)
                 if w > 1:
                     self._state, toks, hist, nc = eng.dispatch_guard(
                         "chunk",
@@ -4151,7 +4339,46 @@ class ContinuousDecodeLoop:
     def warm(self) -> None:
         """Compile the loop's executables off the request path: the
         empty-state template, the insert scatter per seq bucket, and
-        the batched chunk in both greedy and sampled variants."""
+        the batched chunk in both greedy and sampled variants.  With
+        the fleet-shared ExecutableCache every wrapper may already
+        exist (a sibling replica built it), in which case this whole
+        pass is dispatches only — zero XLA compiles, the property the
+        spawn fast-path banks on (docs/compilation.md)."""
+        from ..runtime.compile_cache import warm_phase
+
+        with warm_phase(self.engine.bundle.name, "loop"):
+            self._warm_inner()
+
+    def warm_spawn(self, donor: "ContinuousDecodeLoop | None" = None
+                   ) -> None:
+        """λScale spawn warm (docs/compilation.md): with a donor loop
+        alive, every executable this loop will ever dispatch already
+        sits in the process-level ExecutableCache — so skip the
+        warm-dispatch grid entirely.  Build the device state (the one
+        real dispatch), adopt the donor's measured chain depth and
+        admit grace instead of re-running the RTT calibration, and let
+        the fleet's probe dispatch be the gate before routing.  On a
+        1-core host this is the difference between a spawn that steals
+        ~100 s of grid dispatches from the serving core and one that
+        costs a single template build (BASELINE.md r19).  Variants the
+        donor never compiled (e.g. sampled executables under
+        WARMUP_SAMPLING=0) defer to first use — exactly the donor's
+        own behavior.  No donor → the full warm."""
+        if donor is None:
+            self.warm()
+            return
+        from ..runtime.compile_cache import warm_phase
+
+        with warm_phase(self.engine.bundle.name, "loop"):
+            if self._state is None:
+                self._build_empty_state()
+            self.chain_depth = max(1, int(donor.chain_depth))
+            self._admit_grace_s = donor._admit_grace_s
+            metrics.CHAIN_DEPTH.labels(self.engine.bundle.name).set(
+                self.chain_depth
+            )
+
+    def _warm_inner(self) -> None:
         import jax
 
         eng = self.engine

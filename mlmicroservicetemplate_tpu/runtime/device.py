@@ -16,18 +16,27 @@ import dataclasses
 import os
 
 
-def enable_compilation_cache(device: str) -> str | None:
+def enable_compilation_cache(device: str,
+                             cache_dir: str | None = None) -> str | None:
     """Persistent XLA compilation cache: restarts reuse compiled
     executables instead of re-paying warmup (52–487 s per model through
-    the remote-compile relay, BASELINE.md warmup table).
+    the remote-compile relay, BASELINE.md warmup table).  This is the
+    bottom rung of the compile-cache hierarchy (docs/compilation.md):
+    jit's per-wrapper cache and the process-level ExecutableCache
+    (runtime/compile_cache.py) sit above it and cover in-process reuse;
+    this disk cache is what carries compiles ACROSS processes.
 
     Default ON for DEVICE=tpu at ``~/.cache/mlmst-xla-cache``;
-    ``COMPILE_CACHE_DIR=<path>`` overrides, ``COMPILE_CACHE_DIR=`` /
-    ``=0`` disables.  Returns the active dir (None = disabled).
-    CPU compiles are fast and golden tests want cold compiles, so CPU
-    stays off unless a dir is given explicitly.
+    ``cache_dir`` (the ``COMPILE_CACHE_DIR`` ServiceConfig knob —
+    utils/config.py, validated and README-documented under the
+    knob-drift rule) overrides, ``"0"``/``"off"``/empty disables.
+    ``cache_dir=None`` falls back to the raw ``COMPILE_CACHE_DIR`` env
+    var for pre-config callers (benchmarks).  Returns the active dir
+    (None = disabled).  CPU compiles are fast and golden tests want
+    cold compiles, so CPU stays off unless a dir is given explicitly.
     """
-    env = os.environ.get("COMPILE_CACHE_DIR")
+    env = cache_dir if cache_dir is not None \
+        else os.environ.get("COMPILE_CACHE_DIR")
     if env is not None and env.strip().lower() in ("", "0", "false", "no", "off"):
         return None
     if env:
@@ -39,6 +48,15 @@ def enable_compilation_cache(device: str) -> str | None:
     import jax
 
     os.makedirs(cache_dir, exist_ok=True)
+    # jax latches the no-dir decision at its FIRST compile; a process
+    # that already compiled something (benchmark harnesses, tests)
+    # would silently ignore the dir without this reset.
+    try:  # internal seam; absence just means nothing was latched
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:
+        pass
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # Cache everything the warmup compiles, not just slow ones: through
     # the relay even "fast" compiles cost seconds of round-trips.
@@ -47,7 +65,8 @@ def enable_compilation_cache(device: str) -> str | None:
     return cache_dir
 
 
-def apply_device_env(device: str) -> None:
+def apply_device_env(device: str, compile_cache_dir: str | None = None
+                     ) -> None:
     """Map DEVICE=tpu|cpu onto JAX_PLATFORMS before jax is imported.
 
     tpu: leave platform selection to the environment (PJRT TPU plugin
@@ -55,9 +74,10 @@ def apply_device_env(device: str) -> None:
     back to CPU). cpu: force the CPU backend.
 
     Also enables the persistent compilation cache (see
-    ``enable_compilation_cache``).
+    ``enable_compilation_cache``; ``compile_cache_dir`` is the
+    ServiceConfig knob, None = env-var fallback).
     """
-    enable_compilation_cache(device)
+    enable_compilation_cache(device, compile_cache_dir)
     if device != "cpu":
         return
     os.environ["JAX_PLATFORMS"] = "cpu"

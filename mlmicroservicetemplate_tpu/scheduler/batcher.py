@@ -252,11 +252,32 @@ class Batcher:
     def warmup(self) -> None:
         """Blocking: compile the continuous-batching executables (slot
         insert, batched chunk) so the first stream pays no compiles.
-        Called from the app's warmup executor, after engine.warmup."""
+        Called from the app's warmup executor, after engine.warmup.
+        With the process-level ExecutableCache every replica past the
+        first warms compile-free (runtime/compile_cache.py)."""
         if self.fleet is not None:
             self.fleet.warm()
         elif self._cdl is not None:
             self._cdl.warm()
+
+    def compile_status(self) -> dict:
+        """/status.compile: the executable-cache counters, accumulated
+        warm-phase seconds and process XLA compile totals — the
+        operator answer to "what did warming cost and is the cache
+        actually sharing" (docs/compilation.md)."""
+        from ..runtime.compile_cache import (
+            cache_stats,
+            compile_counters,
+            warm_stats,
+        )
+
+        comp = compile_counters()
+        return {
+            "executable_cache": cache_stats(),
+            "warm_phases_s": warm_stats(),
+            "xla_compiles": comp["count"],
+            "xla_compile_s": round(comp["seconds"], 3),
+        }
 
     # ------------------------------------------------------------------
     # drain lifecycle (SIGTERM)

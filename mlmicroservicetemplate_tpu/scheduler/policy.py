@@ -298,6 +298,19 @@ class DecodeWindowGovernor:
         self._last = w
         return w
 
+    def preview(self, max_chunks: int, interactive_live: bool,
+                interactive_waiting: bool) -> int:
+        """``pick`` without the side effects (no ``_last`` transition,
+        no recorder event): the double-buffered host prep stages the
+        NEXT dispatch's window with it, so the real ``pick`` at
+        dispatch time stays the single source of governor telemetry."""
+        if self.cap <= 1 or max_chunks <= 1:
+            return 1
+        if self.auto and (interactive_live or interactive_waiting):
+            return 1
+        w = min(self.cap, int(max_chunks))
+        return 1 << (w.bit_length() - 1)
+
 
 class DeadlineQueue:
     """Bounded two-class EDF wait queue (see module docstring).

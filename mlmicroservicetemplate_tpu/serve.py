@@ -80,7 +80,7 @@ def build_service(overrides: dict | None = None):
 
     from .runtime.device import apply_device_env
 
-    apply_device_env(cfg.device)
+    apply_device_env(cfg.device, cfg.compile_cache_dir)
 
     from .api import build_app
     from .engine import InferenceEngine

@@ -36,6 +36,16 @@ class ServiceConfig(BaseModel):
     # unset, text models fall back to the built-in byte-level tokenizer.
     tokenizer_path: str | None = None
 
+    # Persistent XLA compilation cache directory (runtime/device.py,
+    # docs/compilation.md): restarts and fleet spawns reuse compiled
+    # executables from disk instead of re-paying warmup.  Unset =
+    # device default (ON for DEVICE=tpu at ~/.cache/mlmst-xla-cache;
+    # OFF on cpu — CPU compiles are fast and golden tests want cold
+    # compiles).  A path enables it anywhere; "0"/"off" disables even
+    # on tpu.  The same setting is also read from the
+    # COMPILE_CACHE_DIR env var for pre-config callers (benchmarks).
+    compile_cache_dir: str | None = None
+
     # HTTP surface (L4).
     host: str = "0.0.0.0"
     port: int = 8000
@@ -305,6 +315,16 @@ class ServiceConfig(BaseModel):
     # backfill.  0 = always fuse to the cap (throughput lanes with no
     # interactive SLA).
     decode_window_auto: bool = True
+    # Double-buffered host dispatch prep (engine/streams.py,
+    # docs/compilation.md): while chunk N is in flight, the loop
+    # stages iteration N+1's host-side prep — the paged block-growth
+    # pass, table assembly and the table's host→device upload — so it
+    # overlaps N's device compute instead of serializing between
+    # dispatches.  Token-identical by construction (a stale staged
+    # plan rolls back and re-preps inline); measured at
+    # dispatch_host_seconds{site="prep"}.  Off = the serial prep
+    # order, exactly.
+    host_prep_double: bool = True
     # Interactive arrivals may preempt batch-class streams (checkpoint
     # the cursor, free the slot, re-queue for token-identical resume)
     # when every slot is busy.  Only reachable with MAX_STREAM_QUEUE>0.
@@ -795,7 +815,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       FLEET_MIN_REPLICAS, FLEET_MAX_REPLICAS, SCALE_UP_QUEUE,
       SCALE_UP_KV_FRAC, SCALE_UP_TTFT_MS, SCALE_UP_COOLDOWN_S,
       SCALE_DOWN_LOAD, SCALE_DOWN_COOLDOWN_S, SCALE_PERIOD_S,
-      TRACE, TRACE_RING, FLIGHT_RING, PROFILE_DIR, LOG_FORMAT.
+      TRACE, TRACE_RING, FLIGHT_RING, PROFILE_DIR, LOG_FORMAT,
+      COMPILE_CACHE_DIR, HOST_PREP_DOUBLE.
     """
     e = dict(os.environ)
     if env:
@@ -825,6 +846,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "profile_dir": "PROFILE_DIR",
         "journal_dir": "JOURNAL_DIR",
         "journal_fsync": "JOURNAL_FSYNC",
+        "compile_cache_dir": "COMPILE_CACHE_DIR",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -898,6 +920,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PREEMPT")
     if v is not None:
         kwargs["preempt"] = v.lower() not in ("0", "false", "no")
+    v = get("HOST_PREP_DOUBLE")
+    if v is not None:
+        kwargs["host_prep_double"] = v.lower() not in ("0", "false", "no")
     v = get("DECODE_WINDOW_AUTO")
     if v is not None:
         kwargs["decode_window_auto"] = v.lower() not in ("0", "false", "no")

@@ -321,9 +321,10 @@ DISPATCH_HOST = Histogram(
     "dispatch_host_seconds",
     "Host time one guarded device dispatch spent from submit to "
     "return, by dispatch site (prefill | prefill_chunk | chunk | "
-    "fetch | batch | handoff | swap) — the host-side half of the "
-    "host-vs-device attribution split (TRACE=1 spans carry the "
-    "device half)",
+    "fetch | batch | handoff | swap | prep) — the host-side half of "
+    "the host-vs-device attribution split (TRACE=1 spans carry the "
+    "device half); prep is the double-buffered host prep staged while "
+    "the previous chunk is in flight",
     ["model", "site"], buckets=_FINE_BUCKETS,
 )
 JOURNAL_FSYNC = Histogram(
@@ -332,6 +333,24 @@ JOURNAL_FSYNC = Histogram(
     "record on the delivery path; interval amortizes; off never "
     "observes here)",
     ["model"], buckets=_FINE_BUCKETS,
+)
+WARM_SECONDS = Histogram(
+    "engine_warm_seconds",
+    "Wall seconds one warm phase took (engine = engine.warmup bucket "
+    "grid, loop = ContinuousDecodeLoop.warm, spawn_build / spawn_warm "
+    "/ spawn_probe = the fleet scale-up breakdown) — with the "
+    "fleet-shared executable cache a second replica's loop/spawn "
+    "phases collapse to dispatch time, zero XLA compiles",
+    ["model", "phase"],
+    buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+)
+EXEC_CACHE_EVENTS = Counter(
+    "executable_cache_events_total",
+    "Process-level ExecutableCache lookups by event (hit = an existing "
+    "jitted wrapper was shared — the zero-compile spawn/restart path; "
+    "miss = no wrapper under the key; insert = a freshly built wrapper "
+    "was cached) — runtime/compile_cache.py, docs/compilation.md",
+    ["event"],
 )
 TBT = Histogram(
     "stream_tbt_seconds",

@@ -144,6 +144,22 @@ else
     echo "== autoscale smoke skipped (SCALE_SMOKE=0) =="
 fi
 
+# Staged-prep smoke (r19, docs/compilation.md): an rN:-scoped fatal
+# on replica 1's double-buffered host-prep upload (site `prep`) must
+# fail its streams over token-identically onto the survivor with both
+# pool ledgers drained — the chaos pin that HOST_PREP_DOUBLE's staged
+# grants never outlive a dead replica (chaos tier, so it stays out of
+# tier-1).  PREP_SMOKE=0 skips.
+if [ "${PREP_SMOKE:-1}" != "0" ]; then
+    echo "== staged-prep smoke (r1:prep:fatal@1 failover, LOCKTRACE=1) =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu LOCKTRACE=1 \
+        python -m pytest \
+        tests/test_compile_cache.py::test_prep_kill_fails_over_token_identically \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== staged-prep smoke skipped (PREP_SMOKE=0) =="
+fi
+
 # Tiered-KV smoke: the host-RAM swap path under a fatal chunk fault
 # with a tiny KV_HOST_BUDGET_MB — recovery must resume every stream
 # token-identically from the HOST copy, with zero re-prefill chunks
