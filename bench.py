@@ -209,7 +209,17 @@ async def bench_serving() -> "tuple[dict, object]":
             },
         }
 
+        # Perf observatory (round 20): the always-on device busy/bubble
+        # + MFU estimate — the device-side numbers every BENCH json has
+        # been missing since r05, now recorded WITHOUT the TRACE=1
+        # serialization (utils/perfobs.py, docs/observability.md).
+        perf_est = getattr(engine, "perf", None)
+        perf_block = perf_est.snapshot() if perf_est is not None else {}
+        perf_block.pop("device_busy_s", None)  # per-site detail stays
+        # in /debug/perf; the json keeps the headline aggregates.
+
         return {
+            "perf": perf_block,
             "p50_ms": round(statistics.median(lats) * 1000, 3),
             "p99_ms": round(
                 sorted(lats)[max(0, math.ceil(len(lats) * 0.99) - 1)] * 1000, 3

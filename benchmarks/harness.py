@@ -34,6 +34,7 @@ class ServiceUnderTest:
         self.overrides = {"LOG_LEVEL": "WARNING", **overrides}
         self.client = None
         self.engine = None
+        self.batcher = None
 
     async def __aenter__(self):
         from aiohttp.test_utils import TestClient, TestServer
@@ -42,6 +43,7 @@ class ServiceUnderTest:
 
         cfg, bundle, engine, batcher, app = build_service(self.overrides)
         self.engine = engine
+        self.batcher = batcher
         self.client = TestClient(TestServer(app))
         await self.client.start_server()
         for _ in range(2400):

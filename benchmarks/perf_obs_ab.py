@@ -1,0 +1,110 @@
+"""Perf-observatory overhead A/B (r20 acceptance pin).
+
+Interleaved passes of the SAME gpt2 streaming workload with the
+always-on attribution layer ON (PERF_OBS=1, the default) vs OFF —
+alternating arm order per pass so box weather lands on both arms
+equally (the r11 interleaving methodology).  The claim under test:
+the zero-sync estimator's overhead stays within the box-noise
+envelope (r11 measured ±10–19% between *identical-code* passes on
+this 1-vCPU box; TRACE=1 attribution mode costs 8–15% — the thing
+this layer exists to avoid).
+
+Also asserts the structural pin directly: both arms issue identical
+chunk-dispatch counts (the layer adds zero device syncs).
+
+    PERFOBS_AB=0 skips it in run_all.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+from harness import ServiceUnderTest  # noqa: E402
+
+PASSES = int(os.environ.get("PERFOBS_AB_PASSES", "3"))
+N_STREAMS = int(os.environ.get("PERFOBS_AB_STREAMS", "6"))
+
+# Greedy-only workload: skip the sampled warm variants (halves the
+# seq2seq warm grid per service instance; the in-process
+# ExecutableCache then makes every arm past the first warm-fast).
+os.environ.setdefault("WARMUP_SAMPLING", "0")
+
+
+async def run_arm(perf_obs: bool) -> dict:
+    overrides = {
+        "MODEL_NAME": "gpt2",
+        "BATCH_BUCKETS": "1,8",
+        "SEQ_BUCKETS": "64",
+        "MAX_DECODE_LEN": "32",
+        "PERF_OBS": "1" if perf_obs else "0",
+    }
+    if os.environ.get("DEVICE"):
+        overrides["DEVICE"] = os.environ["DEVICE"]
+    async with ServiceUnderTest(overrides) as s:
+        r = await s.stream_stats(
+            "the quick brown fox jumps over the lazy dog and", n=N_STREAMS
+        )
+        cdl = getattr(s.batcher, "_cdl", None)
+        snap = (
+            s.engine.perf.snapshot()
+            if getattr(s.engine, "perf", None) is not None else {}
+        )
+        return {
+            **r,
+            "chunk_dispatches": getattr(cdl, "chunk_dispatches", 0),
+            "tokens": getattr(cdl, "tokens_emitted", 0),
+            "busy_ratio": snap.get("busy_ratio"),
+            "mfu_epoch": snap.get("mfu_epoch"),
+            "pending": snap.get("pending_dispatches"),
+        }
+
+
+async def main() -> None:
+    on_rates, off_rates = [], []
+    on_last = off_last = None
+    for p in range(PASSES):
+        order = [(True,), (False,)] if p % 2 == 0 else [(False,), (True,)]
+        for (flag,) in order:
+            r = await run_arm(flag)
+            (on_rates if flag else off_rates).append(r["decode_steps_s"])
+            if flag:
+                on_last = r
+            else:
+                off_last = r
+    on_med = statistics.median(on_rates)
+    off_med = statistics.median(off_rates)
+    delta = (on_med - off_med) / off_med if off_med else 0.0
+    structural_identical = (
+        on_last["chunk_dispatches"] == off_last["chunk_dispatches"]
+        and on_last["tokens"] == off_last["tokens"]
+    )
+    out = {
+        "ab": "perf_obs_overhead",
+        "passes": PASSES,
+        "on_decode_steps_s": on_rates,
+        "off_decode_steps_s": off_rates,
+        "on_median": round(on_med, 3),
+        "off_median": round(off_med, 3),
+        "overhead_frac": round(delta, 4),
+        "chunk_dispatches_identical": structural_identical,
+        "on_busy_ratio": on_last.get("busy_ratio"),
+        "on_pending_after": on_last.get("pending"),
+    }
+    print(json.dumps(out))
+    if not structural_identical:
+        print(
+            "STRUCTURAL PIN FAILED: PERF_OBS changed dispatch counts",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -26,6 +26,15 @@ _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _here)
 sys.path.insert(0, os.path.dirname(_here))  # repo root, for the package
 from harness import ServiceUnderTest, png_bytes, post_image, post_text  # noqa: E402
+from perf_ledger import append_row, structural_counters  # noqa: E402
+
+
+def _ledger(config: str, s: ServiceUnderTest) -> None:
+    """One structural-counter row per measured config (r20 satellite:
+    the perf-regression ledger, PERF_LEDGER.jsonl — counters, not
+    wall-clock, so the longitudinal diff is CPU-noise-immune)."""
+    cdl = getattr(s.batcher, "_cdl", None) if s.batcher is not None else None
+    append_row(config, structural_counters(s.engine, cdl))
 
 
 async def main() -> None:
@@ -40,6 +49,7 @@ async def main() -> None:
         rows.append({"config": "resnet50 single-image latency", **r1})
         r3 = await s.throughput(post_image(png))
         rows.append({"config": "resnet50 dynamic batching max_batch=32", **r3})
+        _ledger("resnet50 dynamic batching", s)
 
     async with ServiceUnderTest(
         {"MODEL_NAME": "bert-base", "BATCH_BUCKETS": "1,8,32", "SEQ_BUCKETS": "32,128", **dev}
@@ -51,6 +61,7 @@ async def main() -> None:
         rows.append(
             {"config": f"bert-base replica serving ({n_dev} device)", **r4}
         )
+        _ledger("bert-base replica serving", s)
 
     async with ServiceUnderTest(
         {
@@ -63,6 +74,7 @@ async def main() -> None:
     ) as s:
         r5 = await s.stream_stats("summarize: the quick brown fox jumps over the lazy dog")
         rows.append({"config": "t5-small streaming seq2seq", **r5})
+        _ledger("t5-small streaming", s)
 
     async with ServiceUnderTest(
         {
@@ -75,6 +87,7 @@ async def main() -> None:
     ) as s:
         r6 = await s.stream_stats("the quick brown fox jumps over the lazy dog and")
         rows.append({"config": "gpt2 streaming causal-LM", **r6})
+        _ledger("gpt2 streaming", s)
 
     # The flagship generative config: llama at TinyLlama-1.1B dims,
     # int8 weights (the measured recommendation at this scale).
@@ -90,6 +103,7 @@ async def main() -> None:
     ) as s:
         r7 = await s.stream_stats("the quick brown fox jumps over the lazy dog and")
         rows.append({"config": "llama-1.1B int8 streaming causal-LM", **r7})
+        _ledger("llama int8 streaming", s)
 
     import jax
 
@@ -194,6 +208,15 @@ async def main() -> None:
     if os.environ.get("FLEET_AB", "1").lower() not in ("0", "false", "no"):
         subprocess.run(
             [sys.executable, os.path.join(_here, "replica_failover_ab.py")],
+            check=False,
+        )
+
+    # Perf observatory (round-20 tentpole): overhead of the always-on
+    # zero-sync attribution layer vs PERF_OBS=0, interleaved, plus the
+    # structural dispatch-count pin.  PERFOBS_AB=0 skips.
+    if os.environ.get("PERFOBS_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "perf_obs_ab.py")],
             check=False,
         )
 

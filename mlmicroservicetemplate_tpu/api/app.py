@@ -133,6 +133,7 @@ def build_app(cfg, bundle: ModelBundle, engine, batcher: Batcher) -> web.Applica
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/debug/engine", handle_engine_debug)
+    app.router.add_get("/debug/perf", handle_perf_debug)
     app.router.add_post("/debug/profile", handle_profile)
 
     # Bulk inference lane (JOBS_ENABLED; jobs/api.py): the /v1/batches
@@ -1471,6 +1472,30 @@ async def handle_status(request: web.Request) -> web.Response:
         # hit/miss/insert counts, per-phase warm seconds, process XLA
         # compile totals — what a fleet spawn or restart actually paid.
         body["compile"] = batcher.compile_status()
+    # Perf observatory (r20; utils/perfobs.py, docs/observability.md):
+    # always-on device busy/bubble + MFU estimate, SLO burn rates —
+    # the compact operator view (/debug/perf has the full detail).
+    perf = getattr(engine, "perf", None)
+    if perf is not None:
+        if fleet is not None:
+            psnap = fleet.perf_status()
+            body["perf"] = {
+                k: v for k, v in psnap.items() if k != "per_replica"
+            }
+        else:
+            psnap = perf.snapshot()
+            body["perf"] = {
+                "enabled": psnap["enabled"],
+                "device_busy_total_s": psnap["device_busy_total_s"],
+                "device_bubble_s": psnap["device_bubble_s"],
+                "busy_ratio": psnap["busy_ratio"],
+                "prep_overlap_s": psnap["prep_overlap_s"],
+                "mfu_estimate": psnap["mfu_estimate"],
+                "modeled_flops_total": psnap["modeled_flops_total"],
+            }
+            slo = getattr(cdl, "slo", None) if cdl is not None else None
+            if slo is not None:
+                body["perf"]["slo"] = slo.snapshot()
     tr = tracing.tracer()
     body["observability"] = {
         "trace": tr is not None,
@@ -1525,11 +1550,62 @@ async def handle_trace(request: web.Request) -> web.Response:
     return web.json_response(out)
 
 
+def _loop_summary(cdl) -> dict:
+    return {
+        "active": len(cdl.active),
+        "queued": cdl.queue.qsize(),
+        "prefilling": len(cdl._prefilling),
+        "swapping": len(getattr(cdl, "_swapping", ())),
+        "chunk_dispatches": cdl.chunk_dispatches,
+        "prefill_dispatches": cdl.prefill_dispatches,
+        "preemptions": cdl.preemptions,
+    }
+
+
 async def handle_engine_debug(request: web.Request) -> web.Response:
     """``GET /debug/engine`` — the engine flight recorder: the last N
     loop iterations (batch composition, slot occupancy, KV pool
-    state), scheduling/fault events, and the last fatal-fault dump."""
+    state), scheduling/fault events, and the last fatal-fault dump.
+
+    ``?all=1`` (r20, fleet mode): every live replica's flight snapshot
+    merged into ONE replica-tagged timeline — iterations and events
+    from all loops plus the fleet's recent scale/failover events,
+    sorted by timestamp — instead of the base engine's ring alone (a
+    failover post-mortem spans the dead replica AND its adopter)."""
     engine = request.app[K_ENGINE]
+    batcher = request.app[K_BATCHER]
+    fleet = getattr(batcher, "fleet", None)
+    want_all = request.query.get("all", "").lower() in ("1", "true", "yes")
+    if want_all and fleet is not None:
+        replicas: dict = {}
+        timeline: list = []
+        for rep in fleet.replicas:
+            fl = getattr(rep.engine, "flight", None)
+            if fl is None:
+                continue
+            snap = fl.snapshot()
+            replicas[str(rep.id)] = {
+                "breaker": "dead" if rep.dead else rep.breaker.state_name,
+                "dumps": snap.get("dumps", 0),
+                "loop": _loop_summary(rep.cdl),
+                "dispatch_attribution": rep.engine.dispatch_attribution(),
+            }
+            for it in snap.get("iterations", ()):
+                timeline.append({**it, "replica": rep.id, "kind": "iteration"})
+            for ev in snap.get("events", ()):
+                timeline.append({**ev, "replica": rep.id, "kind": "event"})
+        # Scale/failover events carry no flight timestamp of their own;
+        # tag them so the merged view shows WHEN the fleet moved
+        # relative to each loop's iterations.
+        for ev in fleet.scaling_status().get("recent", ()):
+            timeline.append({**ev, "kind": "scale"})
+        timeline.sort(key=lambda e: e.get("t", float("inf")))
+        return web.json_response({
+            "fleet": True,
+            "replicas": replicas,
+            "failovers": fleet.failovers,
+            "timeline": timeline,
+        })
     flight = getattr(engine, "flight", None)
     if flight is None:
         raise web.HTTPNotFound(reason="engine has no flight recorder")
@@ -1538,17 +1614,39 @@ async def handle_engine_debug(request: web.Request) -> web.Response:
         engine.dispatch_attribution()
         if hasattr(engine, "dispatch_attribution") else {}
     )
-    cdl = getattr(request.app[K_BATCHER], "_cdl", None)
+    cdl = getattr(batcher, "_cdl", None)
     if cdl is not None:
-        body["loop"] = {
-            "active": len(cdl.active),
-            "queued": cdl.queue.qsize(),
-            "prefilling": len(cdl._prefilling),
-            "swapping": len(getattr(cdl, "_swapping", ())),
-            "chunk_dispatches": cdl.chunk_dispatches,
-            "prefill_dispatches": cdl.prefill_dispatches,
-            "preemptions": cdl.preemptions,
-        }
+        body["loop"] = _loop_summary(cdl)
+    return web.json_response(body)
+
+
+async def handle_perf_debug(request: web.Request) -> web.Response:
+    """``GET /debug/perf`` (r20 perf observatory) — the full always-on
+    attribution detail: per-site device busy/bubble estimates, prep
+    overlap, modeled FLOPs by executable kind, the rolling MFU
+    estimate with its raw components, SLO burn rates, and per-replica
+    breakdown in fleet mode (docs/observability.md)."""
+    from ..runtime.compile_cache import cost_stats
+
+    engine = request.app[K_ENGINE]
+    batcher = request.app[K_BATCHER]
+    perf = getattr(engine, "perf", None)
+    if perf is None:
+        raise web.HTTPNotFound(reason="engine has no perf estimator")
+    fleet = getattr(batcher, "fleet", None)
+    if fleet is not None:
+        body = fleet.perf_status()
+    else:
+        body = perf.snapshot()
+        cdl = getattr(batcher, "_cdl", None)
+        slo = getattr(cdl, "slo", None) if cdl is not None else None
+        if slo is not None:
+            body["slo"] = slo.snapshot()
+    body["analyzed_signatures"] = cost_stats()
+    body["dispatch_attribution"] = (
+        engine.dispatch_attribution()
+        if hasattr(engine, "dispatch_attribution") else {}
+    )
     return web.json_response(body)
 
 

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..models.registry import KIND_IMAGE, KIND_SEQ2SEQ, KIND_TEXT, ModelBundle
 from ..parallel import ReplicaSet, make_mesh
-from ..utils import locktrace, metrics, tracing
+from ..utils import locktrace, metrics, perfobs, tracing
 
 log = logging.getLogger(__name__)
 
@@ -83,6 +83,17 @@ class InferenceEngine:
         # this split so "relay RTT dominates" is machine-checked.
         self.dispatch_stats: dict[str, list] = {}
         self._dispatch_stats_lock = threading.Lock()
+        # Perf observatory (r20; utils/perfobs.py): always-on device
+        # busy/bubble estimation from submit stamps + the loop's
+        # existing fetch seams — zero extra syncs, PERF_OBS=0 keeps no
+        # timestamps at all (pinned).  The process-level switch also
+        # gates the compile cache's cost-analysis accrual.
+        perfobs.configure(bool(getattr(cfg, "perf_obs", True)))
+        self.perf = perfobs.DeviceOccupancy(
+            bundle.name,
+            enabled=bool(getattr(cfg, "perf_obs", True)),
+            peak_flops=perfobs.peak_flops(cfg),
+        )
         self.faults = FaultInjector.from_spec(
             getattr(cfg, "fault_spec", None),
             int(getattr(cfg, "fault_seed", 0) or 0),
@@ -794,12 +805,18 @@ class InferenceEngine:
         if tr is None:
             t0 = time.perf_counter()
             out = self.watchdog.run(site, fn)
-            self._note_dispatch(site, time.perf_counter() - t0, None)
+            t1 = time.perf_counter()
+            self._note_dispatch(site, t1 - t0, None)
+            # Perf observatory submit stamp: the SAME two clock reads
+            # the host attribution above already paid — no extra
+            # reads, no syncs (utils/perfobs.py).
+            self.perf.on_guard(site, t0, t1)
             return out
         with tr.span(f"dispatch:{site}", cat="dispatch") as sp:
             t0 = time.perf_counter()
             out = self.watchdog.run(site, fn)
             host_s = time.perf_counter() - t0
+            self.perf.on_guard(site, t0, t0 + host_s)
             device_s = None
             try:
                 import jax
@@ -1114,6 +1131,9 @@ class InferenceEngine:
                 toks_np, done_np = self.dispatch_guard(
                     "fetch", lambda: jax.device_get((toks, state.done))
                 )
+                # Completion seam: the fetch returned, so the fused
+                # prefill it consumed has finished on the device.
+                self.perf.note_complete("prefill")
                 chunk, done = toks_np[0], bool(done_np[0])
             # Request max_tokens bounds chunk spending, and the final
             # chunk trims to the exact budget — raw emission never
@@ -1137,6 +1157,7 @@ class InferenceEngine:
                         "fetch",
                         lambda: jax.device_get((toks, state.done)),
                     )
+                    self.perf.note_complete("chunk")
                     chunk, done = toks_np[0], bool(done_np[0])
                 yield chunk[: budget - produced]
                 produced += self.chunk_tokens
@@ -1228,6 +1249,7 @@ class InferenceEngine:
             out_np, ns_np, done_np = self.dispatch_guard(
                 "fetch", lambda: jax.device_get((out, ns, ss.base.done))
             )
+            self.perf.note_complete("prefill")
         chunk = flatten_emitted(out_np, ns_np, 0)
         metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
             int(chunk.size) / max(1, n_verify)
@@ -1272,6 +1294,7 @@ class InferenceEngine:
                     "fetch",
                     lambda: jax.device_get((out, ns, ss.base.done)),
                 )
+                self.perf.note_complete("chunk")
             chunk = flatten_emitted(out_np, ns_np, 0)
             metrics.SPEC_EMITTED.labels(self.bundle.name).observe(
                 int(chunk.size) / max(1, n_verify)

@@ -322,6 +322,10 @@ class ReplicaFleet:
             self.replicas[0].engine, "kv_disk", None
         )
         self._shared_host = getattr(self.replicas[0].engine, "kv_host", None)
+        # ONE SLO tracker for the whole fleet (r20, like the tiers):
+        # burn rates are a fleet-level signal — a replica-local window
+        # would let a degraded replica hide behind healthy siblings.
+        self._shared_slo = getattr(self.replicas[0].cdl, "slo", None)
         for rep in self.replicas:
             self._share_tiers(rep)
         # Elastic scaling state: the governor decides, scale_tick acts.
@@ -349,6 +353,9 @@ class ReplicaFleet:
                 down_load=float(getattr(cfg, "scale_down_load", 0.25)),
                 down_cooldown_s=float(
                     getattr(cfg, "scale_down_cooldown_s", 10.0)
+                ),
+                up_slo_burn=float(
+                    getattr(cfg, "scale_up_slo_burn", 0.0) or 0.0
                 ),
                 clock=clock,
             )
@@ -408,6 +415,8 @@ class ReplicaFleet:
             rep.engine.kv_host = self._shared_host
         if getattr(rep.engine, "journal", None) is None:
             rep.engine.journal = self._shared_journal
+        if self._shared_slo is not None:
+            rep.cdl.slo = self._shared_slo
         old = getattr(rep.engine, "kv_disk", None)
         if old is not None and old is not self._shared_disk:
             # A rebuilt replica-0 engine (split-budget pool) built its
@@ -898,9 +907,17 @@ class ReplicaFleet:
             used = sum(r.admission.pool.used_blocks for r in live)
             kv_frac = used / total if total else 0.0
         ttft = max((r.cdl.ttft_ewma_s for r in live), default=0.0)
+        # SLO burn (r20): the shared tracker's worst fast-window burn
+        # across every enabled objective — 0.0 with no objectives set,
+        # so the pre-SLO governor inputs are bit-identical by default.
+        slo_burn = (
+            self._shared_slo.worst_burn()
+            if self._shared_slo is not None else 0.0
+        )
         return {
             "live": len(live), "queued": queued, "active": active,
             "slots": slots, "kv_frac": kv_frac, "ttft_ewma_s": ttft,
+            "slo_burn": slo_burn,
         }
 
     def scale_tick(self) -> None:
@@ -1017,6 +1034,29 @@ class ReplicaFleet:
             rep.cdl.stop()
 
     # -- observability -------------------------------------------------
+
+    def perf_status(self) -> dict:
+        """Fleet-wide device-occupancy rollup (r20 perf observatory):
+        the per-replica estimator snapshots aggregated, plus the
+        replica-tagged detail — what /status.perf and /debug/perf
+        serve in fleet mode."""
+        from ..utils import perfobs
+
+        per = {}
+        snaps = []
+        for rep in self.replicas:
+            p = getattr(rep.engine, "perf", None)
+            if p is None:
+                continue
+            snap = p.snapshot()
+            per[str(rep.id)] = snap
+            if not rep.dead:
+                snaps.append(snap)
+        out = perfobs.merge_snapshots(snaps)
+        out["per_replica"] = per
+        if self._shared_slo is not None:
+            out["slo"] = self._shared_slo.snapshot()
+        return out
 
     def status(self) -> dict:
         self.sweep()

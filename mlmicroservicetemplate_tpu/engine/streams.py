@@ -568,6 +568,17 @@ class ContinuousDecodeLoop:
         if self.prefill_chunk:
             self._pacer.recorder = self._flight
         self._window_gov.recorder = self._flight
+        # SLO burn-rate tracker (r20; scheduler/policy.SLOTracker):
+        # per-priority-class TTFT/TBT objectives from the SLO_* knobs
+        # feed multi-window burn-rate gauges and the optional
+        # SCALE_UP_SLO_BURN governor signal.  None when every
+        # objective knob is 0 (the default) — zero new work on the
+        # emit path, bit-identical behavior.  A fleet shares replica
+        # 0's tracker (engine/fleet.py re-points it) so the burn rate
+        # is fleet-wide by construction.
+        from ..scheduler.policy import SLOTracker
+
+        self.slo = SLOTracker.from_cfg(engine.bundle.name, cfg)
         metrics.CHAIN_DEPTH.labels(engine.bundle.name).set(self.chain_depth)
 
     # ------------------------------------------------------------------
@@ -1723,12 +1734,16 @@ class ContinuousDecodeLoop:
                     gap if not self.tbt_ewma_s
                     else 0.8 * self.tbt_ewma_s + 0.2 * gap
                 )
+                if self.slo is not None:
+                    self.slo.note("tbt", st.klass, gap)
             else:
                 ttft = now - st.t_in
                 self.ttft_ewma_s = (
                     ttft if not self.ttft_ewma_s
                     else 0.8 * self.ttft_ewma_s + 0.2 * ttft
                 )
+                if self.slo is not None:
+                    self.slo.note("ttft", st.klass, ttft)
             st.t_emit = now
 
     # -- admission -----------------------------------------------------
@@ -2044,6 +2059,10 @@ class ContinuousDecodeLoop:
             except Exception as e:
                 self._fail_streams([st for st, *_ in started], e)
                 return
+        # One prefill dispatch per distinct (toks, done) pair just
+        # completed on the device (the combined fetch synchronized
+        # with all of them).
+        self._perf_complete("prefill", len(uniq))
         for st, state1, toks, sampled, row, ids, mask in started:
             toks_np, done_np = fetched[id(toks)]
             st.produced = eng.chunk_tokens
@@ -4219,6 +4238,11 @@ class ContinuousDecodeLoop:
         fetched = self.engine.dispatch_guard(
             "fetch", lambda: jax.device_get(fetchables)
         )
+        # Perf-observatory completion seam (utils/perfobs.py): the
+        # fetch just synchronized with the oldest in-flight chunk
+        # dispatch finishing on the device — a timestamp the loop was
+        # already paying for, now also a device-occupancy sample.
+        self._perf_complete("chunk")
         self._route_entry(fetched, snapshot, w)
 
     def _deliver_all(self) -> None:
@@ -4233,8 +4257,17 @@ class ContinuousDecodeLoop:
             "fetch",
             lambda: jax.device_get([f for f, _, _ in entries]),
         )
+        self._perf_complete("chunk", len(entries))
         for (_, snapshot, w), got in zip(entries, fetched):
             self._route_entry(got, snapshot, w)
+
+    def _perf_complete(self, site: str, n: int = 1) -> None:
+        """Feed one fetch-seam completion sample to the engine's
+        device-occupancy estimator (duck-typed test engines without
+        one record nowhere)."""
+        p = getattr(self.engine, "perf", None)
+        if p is not None:
+            p.note_complete(site, n)
 
     def _deliver_ready(self) -> None:
         """Opportunistic delivery of in-flight work whose buffers are

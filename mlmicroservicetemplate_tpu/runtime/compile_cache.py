@@ -57,7 +57,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable
 
-from ..utils import metrics
+from ..utils import metrics, perfobs
 
 _LOCK = threading.RLock()
 _CACHE: OrderedDict[tuple, Any] = OrderedDict()
@@ -174,6 +174,106 @@ def placement_key(replicas: Any) -> tuple:
     return ("replicas", id(replicas))
 
 
+# -- modeled-cost accounting (r20 perf observatory) --------------------
+#
+# Every shared executable is wrapped in a thin proxy that, on the
+# first call per argument signature, runs ``Lowered.cost_analysis()``
+# — a trace + lower with ZERO backend compiles (the zero-compile spawn
+# pins stay intact) and zero dispatches — and from then on accrues the
+# memoized modeled FLOPs/bytes into the perfobs book on every call.
+# "Analyzed once per executable": the proxy lives in the process-level
+# cache, so every engine/replica sharing the wrapper shares the memo;
+# a (wrapper, signature) pair IS one XLA executable.  PERF_OBS=0 skips
+# everything past one boolean check per call.
+
+#: Distinct call signatures analyzed per wrapper before the proxy
+#: stops analyzing new ones (a signature that never memoizes — e.g. a
+#: pathological pytree — must not re-pay a trace+lower per dispatch).
+MAX_SIGS = 16
+
+
+def _sig_item(a: Any) -> Any:
+    """Cheap hashable shape signature for one call argument: scalars by
+    value, arrays by (shape, dtype), containers recursively (lists of
+    per-layer cache entries stay cheap), opaque pytrees by identity
+    (``params`` is a stable dict on the engine)."""
+    if a is None or isinstance(a, (bool, int, float, str)):
+        return a
+    shp = getattr(a, "shape", None)
+    dt = getattr(a, "dtype", None)
+    if shp is not None and dt is not None:
+        return (tuple(shp), str(dt))
+    if isinstance(a, dict):
+        return ("dict", id(a))
+    if isinstance(a, (tuple, list)) and len(a) <= 64:
+        return (type(a).__name__,) + tuple(_sig_item(x) for x in a)
+    if hasattr(a, "_fields"):  # NamedTuple decode states
+        return ("nt",) + tuple(_sig_item(getattr(a, f)) for f in a._fields)
+    return ("obj", id(a))
+
+
+class _CostedExecutable:
+    """Call-transparent proxy accruing modeled FLOPs per dispatch."""
+
+    __slots__ = ("_fn", "_kind", "_model", "_costs", "_costs_lock")
+
+    def __init__(self, fn: Any, kind: str, model: str):
+        self._fn = fn
+        self._kind = kind
+        self._model = model
+        self._costs: dict = {}
+        self._costs_lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        if perfobs.enabled():
+            sig = tuple(_sig_item(a) for a in args)
+            c = self._costs.get(sig)
+            if c is None:
+                c = self._analyze(sig, args, kwargs)
+            if c[0] or c[1]:
+                perfobs.note_cost(self._model, self._kind, c[0], c[1])
+        return out
+
+    def _analyze(self, sig, args, kwargs) -> tuple[float, float]:
+        with self._costs_lock:
+            if sig in self._costs:
+                return self._costs[sig]
+            if len(self._costs) >= MAX_SIGS:
+                return (0.0, 0.0)  # saturated: stop analyzing new sigs
+            try:
+                ca = self._fn.lower(*args, **kwargs).cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                cost = (
+                    float(ca.get("flops", 0.0) or 0.0),
+                    float(ca.get("bytes accessed", 0.0) or 0.0),
+                )
+            except Exception:
+                # Backends without HLO cost analysis (or un-lowerable
+                # duck-typed test fns): this executable just accrues
+                # nothing — the estimator degrades, serving does not.
+                cost = (0.0, 0.0)
+            self._costs[sig] = cost
+            return cost
+
+    def __getattr__(self, name: str):
+        # Transparent for .lower()/.trace()/attribute probes.
+        return getattr(self._fn, name)
+
+
+def cost_stats() -> dict:
+    """Analyzed-signature counts per cached wrapper kind (/status +
+    tests): {kind: n_signatures}."""
+    out: dict[str, int] = {}
+    with _LOCK:
+        entries = list(_CACHE.items())
+    for key, fn in entries:
+        if isinstance(fn, _CostedExecutable):
+            out[key[1]] = out.get(key[1], 0) + len(fn._costs)
+    return out
+
+
 def shared_executable(kind: str, bundle: Any, replicas: Any,
                       build: Callable[[], Any], statics: tuple = ()) -> Any:
     """The one lookup every jit-wrapper construction site routes
@@ -196,7 +296,7 @@ def shared_executable(kind: str, bundle: Any, replicas: Any,
         _COUNTS["miss"] += 1
     metrics.EXEC_CACHE_EVENTS.labels("miss").inc()
     _install_monitor()  # first build turns on compile accounting
-    fn = build()
+    fn = _CostedExecutable(build(), kind, model)
     with _LOCK:
         # A racing builder may have inserted meanwhile: last wins is
         # fine (both wrappers are correct; one just goes unshared), but

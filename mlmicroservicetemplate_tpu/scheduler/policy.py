@@ -35,6 +35,9 @@ import heapq
 import itertools
 import threading
 import time
+from collections import deque
+
+from ..utils import metrics
 
 INTERACTIVE = "interactive"
 BATCH = "batch"
@@ -140,6 +143,182 @@ class BackfillGovernor:
         return self.cap
 
 
+class SLOTracker:
+    """Per-priority-class latency-SLO burn-rate tracking (r20 perf
+    observatory; docs/observability.md).
+
+    Objectives come from the ``SLO_TTFT_MS``/``SLO_TBT_MS`` knobs
+    (interactive class) and their ``SLO_BATCH_*`` siblings; a 0 knob
+    disables that (kind, class) objective.  Each delivery the decode
+    loop already measures (TTFT at the first chunk, TBT per inter-chunk
+    gap — ``engine/streams.py::_emit_tokens``) is scored good/bad
+    against its objective, and the classic SRE burn rate is derived
+    over two windows::
+
+        burn = (bad / total within window) / (1 - SLO_TARGET)
+
+    1.0 = consuming the error budget exactly at the sustainable rate;
+    >1 = the SLO is being violated; the FAST window reacts to incidents
+    while the SLOW window filters blips.  Exported as
+    ``slo_{ttft,tbt}_burn_rate{klass, window}`` gauges (rate-limited to
+    ~1/s) and consumed by the ``ScalingGovernor`` when
+    ``SCALE_UP_SLO_BURN`` is set (off by default — bit-identical
+    scaling decisions when unset, pinned).
+
+    Pure policy: clock-injected (tests drive burn windows without
+    sleeping), bounded memory (one deque per objective, pruned to the
+    slow window), thread-safe (the decode loop notes; the governor and
+    /status read)."""
+
+    KINDS = ("ttft", "tbt")
+    WINDOW_NAMES = ("fast", "slow")
+
+    def __init__(self, model: str, objectives: dict, target: float = 0.99,
+                 windows_s: tuple = (60.0, 600.0), clock=None,
+                 max_samples: int = 4096):
+        self.model = model
+        #: {(kind, klass): objective_seconds}, only enabled objectives.
+        self.objectives = {
+            k: float(v) for k, v in objectives.items() if v and v > 0
+        }
+        self.target = float(target)
+        self.windows_s = (float(windows_s[0]), float(windows_s[1]))
+        self._budget = max(1e-9, 1.0 - self.target)
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._max_samples = int(max_samples)
+        self._samples: dict = {
+            key: deque(maxlen=self._max_samples) for key in self.objectives
+        }
+        self._last_export = 0.0
+
+    @classmethod
+    def from_cfg(cls, model: str, cfg, clock=None):
+        """Tracker from the service knobs, or None when every
+        objective is 0 (the default) — the zero-overhead-off gate."""
+        objectives = {
+            ("ttft", INTERACTIVE): float(
+                getattr(cfg, "slo_ttft_ms", 0.0) or 0.0
+            ) / 1e3,
+            ("tbt", INTERACTIVE): float(
+                getattr(cfg, "slo_tbt_ms", 0.0) or 0.0
+            ) / 1e3,
+            ("ttft", BATCH): float(
+                getattr(cfg, "slo_batch_ttft_ms", 0.0) or 0.0
+            ) / 1e3,
+            ("tbt", BATCH): float(
+                getattr(cfg, "slo_batch_tbt_ms", 0.0) or 0.0
+            ) / 1e3,
+        }
+        if not any(v > 0 for v in objectives.values()):
+            return None
+        windows = getattr(cfg, "slo_windows_s", None) or "60,600"
+        try:
+            parts = [float(x) for x in str(windows).split(",") if x.strip()]
+        except ValueError:
+            parts = [60.0, 600.0]
+        if len(parts) != 2 or parts[0] <= 0 or parts[0] >= parts[1]:
+            parts = [60.0, 600.0]
+        return cls(
+            model, objectives,
+            target=float(getattr(cfg, "slo_target", 0.99) or 0.99),
+            windows_s=(parts[0], parts[1]), clock=clock,
+        )
+
+    # -- write side (the decode loop's delivery path) ------------------
+
+    def note(self, kind: str, klass: str, value_s: float) -> None:
+        obj = self.objectives.get((kind, klass))
+        if obj is None:
+            return
+        now = self._clock()
+        with self._lock:
+            q = self._samples[(kind, klass)]
+            q.append((now, value_s <= obj))
+            # Prune past the slow window so burn reads stay O(window).
+            horizon = now - self.windows_s[1]
+            while q and q[0][0] < horizon:
+                q.popleft()
+            export = now - self._last_export >= 1.0
+            if export:
+                self._last_export = now
+        if export:
+            self.export_gauges(now)
+
+    # -- read side -----------------------------------------------------
+
+    def burn_rate(self, kind: str, klass: str,
+                  window_s: float | None = None,
+                  now: float | None = None) -> float:
+        """Burn rate over ``window_s`` (default: the fast window); 0.0
+        with no samples (no traffic = no budget burned)."""
+        if (kind, klass) not in self.objectives:
+            return 0.0
+        window = self.windows_s[0] if window_s is None else float(window_s)
+        now = self._clock() if now is None else now
+        horizon = now - window
+        with self._lock:
+            q = self._samples[(kind, klass)]
+            total = bad = 0
+            for ts, good in reversed(q):
+                if ts < horizon:
+                    break
+                total += 1
+                if not good:
+                    bad += 1
+        if not total:
+            return 0.0
+        return (bad / total) / self._budget
+
+    def worst_burn(self) -> float:
+        """Max fast-window burn across every enabled objective — the
+        single scalar the ScalingGovernor consumes."""
+        return max(
+            (
+                self.burn_rate(kind, klass)
+                for kind, klass in self.objectives
+            ),
+            default=0.0,
+        )
+
+    def export_gauges(self, now: float | None = None) -> None:
+        """Set the burn-rate gauges for every (objective, window)."""
+        now = self._clock() if now is None else now
+        for (kind, klass) in self.objectives:
+            gauge = (
+                metrics.SLO_TTFT_BURN if kind == "ttft"
+                else metrics.SLO_TBT_BURN
+            )
+            for name, win in zip(self.WINDOW_NAMES, self.windows_s):
+                gauge.labels(self.model, klass, name).set(
+                    self.burn_rate(kind, klass, win, now=now)
+                )
+
+    def snapshot(self) -> dict:
+        """/status.perf.slo + /debug/perf: objectives + burn rates."""
+        now = self._clock()
+        out: dict = {
+            "target": self.target,
+            "windows_s": list(self.windows_s),
+            "objectives_ms": {
+                f"{kind}:{klass}": round(obj * 1e3, 3)
+                for (kind, klass), obj in sorted(self.objectives.items())
+            },
+            "burn": {},
+        }
+        for (kind, klass) in sorted(self.objectives):
+            for name, win in zip(self.WINDOW_NAMES, self.windows_s):
+                out["burn"][f"{kind}:{klass}:{name}"] = round(
+                    self.burn_rate(kind, klass, win, now=now), 4
+                )
+        with self._lock:
+            out["samples"] = {
+                f"{kind}:{klass}": len(self._samples[(kind, klass)])
+                for (kind, klass) in sorted(self.objectives)
+            }
+        return out
+
+
 class ScalingGovernor:
     """Decide when the replica fleet should grow or shrink
     (engine/fleet.py drives ``ReplicaFleet`` off these decisions;
@@ -173,12 +352,18 @@ class ScalingGovernor:
     def __init__(self, min_r: int, max_r: int, *, up_queue: float = 2.0,
                  up_kv_frac: float = 0.85, up_ttft_s: float = 0.0,
                  up_cooldown_s: float = 3.0, down_load: float = 0.25,
-                 down_cooldown_s: float = 10.0, clock=None):
+                 down_cooldown_s: float = 10.0, up_slo_burn: float = 0.0,
+                 clock=None):
         self.min_r = max(1, int(min_r))
         self.max_r = max(self.min_r, int(max_r))
         self.up_queue = float(up_queue)
         self.up_kv_frac = float(up_kv_frac)
         self.up_ttft_s = float(up_ttft_s)
+        # SLO-burn scale-up signal (r20; SCALE_UP_SLO_BURN): scale up
+        # when the SLOTracker's worst fast-window burn rate reaches
+        # this threshold.  0 (default) = signal off — decisions are
+        # bit-identical to the pre-SLO governor (pinned).
+        self.up_slo_burn = float(up_slo_burn)
         self.up_cooldown_s = float(up_cooldown_s)
         self.down_load = float(down_load)
         self.down_cooldown_s = float(down_cooldown_s)
@@ -188,10 +373,11 @@ class ScalingGovernor:
 
     def decide(self, *, live: int, queued: int, active: int,
                slots: int, kv_frac: float = 0.0,
-               ttft_ewma_s: float = 0.0) -> tuple[str | None, str]:
+               ttft_ewma_s: float = 0.0,
+               slo_burn: float = 0.0) -> tuple[str | None, str]:
         """(direction, cause) for one governor tick.  direction is
         "up" | "down" | None; cause labels the scale-event counter
-        (queue | kv | ttft | min | idle | steady)."""
+        (queue | kv | ttft | slo | min | idle | steady)."""
         now = self._clock()
         if live <= 0:
             # Nothing alive to compare load against: the rejoin path
@@ -209,6 +395,8 @@ class ScalingGovernor:
                 return "up", "kv"
             if self.up_ttft_s and ttft_ewma_s >= self.up_ttft_s:
                 return "up", "ttft"
+            if self.up_slo_burn and slo_burn >= self.up_slo_burn:
+                return "up", "slo"
         if live > self.min_r:
             survivors = live - 1
             low = (active + queued) <= self.down_load * slots * survivors

@@ -409,6 +409,47 @@ class ServiceConfig(BaseModel):
     # restores the seed's error-every-stream behavior on a fault.
     supervise: bool = True
 
+    # Perf observatory (r20; utils/perfobs.py, docs/observability.md).
+    # Always-on device-time attribution: every guarded dispatch is
+    # stamped at submit and completion is sampled at the loop's
+    # existing fetch seams — device busy/bubble, prep overlap and a
+    # rolling MFU estimate with ZERO extra device syncs (the TRACE=1
+    # block_until_ready attribution mode stays the high-resolution
+    # debugging tool).  0 = the layer keeps no timestamps at all and
+    # the compile cache skips cost analysis (pinned).
+    perf_obs: bool = True
+    # Peak chip TFLOP/s for the MFU denominator; 0 = auto (TPU
+    # device-kind table; unknown on CPU, so mfu_estimate stays 0 and
+    # /debug/perf carries the raw FLOP components instead).
+    peak_tflops: float = 0.0
+    # Latency histogram bucket edges (comma-separated ascending
+    # seconds) for the request/TTFT latency families in
+    # utils/metrics.py; unset = the built-in defaults, which since r20
+    # extend past 10 s (the r11 honest negative: stream TTFT/TBT p99
+    # saturated the old 10 s top bucket on the 1-vCPU box).
+    latency_buckets: str | None = None
+    # SLO objectives per priority class, in ms; 0 disables that
+    # objective.  Interactive-class time-to-first-token / inter-chunk
+    # cadence...
+    slo_ttft_ms: float = 0.0
+    slo_tbt_ms: float = 0.0
+    # ...and the batch-class pair (bulk/background traffic usually
+    # carries a much looser objective, not none).
+    slo_batch_ttft_ms: float = 0.0
+    slo_batch_tbt_ms: float = 0.0
+    # SLO attainment target: the burn-rate denominator is the error
+    # budget (1 - SLO_TARGET); burn 1.0 = consuming it exactly at the
+    # sustainable rate.
+    slo_target: float = 0.99
+    # Burn-rate windows in seconds, "fast,slow" (multi-window
+    # alerting: fast reacts, slow filters blips).
+    slo_windows_s: str = "60,600"
+    # SLO-burn scale-up signal for the ScalingGovernor: scale up when
+    # the worst fast-window burn rate reaches this threshold.  0
+    # (default) = off — governor decisions bit-identical to pre-SLO
+    # behavior (pinned).
+    scale_up_slo_burn: float = 0.0
+
     # Observability.
     log_level: str = "INFO"
     # Log line shape: "text" (the classic formatter) or "json" (one
@@ -786,6 +827,59 @@ class ServiceConfig(BaseModel):
             raise ValueError("TRACE_RING/FLIGHT_RING must be >= 0")
         return v
 
+    @field_validator("peak_tflops", "slo_ttft_ms", "slo_tbt_ms",
+                     "slo_batch_ttft_ms", "slo_batch_tbt_ms",
+                     "scale_up_slo_burn")
+    @classmethod
+    def _check_perf_nonneg(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError(
+                "PEAK_TFLOPS/SLO_TTFT_MS/SLO_TBT_MS/SLO_BATCH_TTFT_MS/"
+                "SLO_BATCH_TBT_MS/SCALE_UP_SLO_BURN must be >= 0 "
+                "(0 = off/auto)"
+            )
+        return v
+
+    @field_validator("slo_target")
+    @classmethod
+    def _check_slo_target(cls, v: float) -> float:
+        if not (0.0 < v < 1.0):
+            raise ValueError(
+                "SLO_TARGET must be in (0, 1) — the error budget is "
+                "1 - SLO_TARGET"
+            )
+        return v
+
+    @field_validator("slo_windows_s")
+    @classmethod
+    def _check_slo_windows(cls, v: str) -> str:
+        try:
+            parts = [float(x) for x in v.split(",") if x.strip()]
+        except ValueError:
+            raise ValueError(
+                f"SLO_WINDOWS_S must be 'fast,slow' seconds, got {v!r}"
+            )
+        if len(parts) != 2 or parts[0] <= 0 or parts[0] >= parts[1]:
+            raise ValueError(
+                "SLO_WINDOWS_S must be two ascending positive durations "
+                f"'fast,slow', got {v!r}"
+            )
+        return v
+
+    @field_validator("latency_buckets")
+    @classmethod
+    def _check_latency_buckets(cls, v: str | None) -> str | None:
+        if v is None or not v.strip():
+            return None
+        from . import metrics as _metrics
+
+        if _metrics.parse_buckets(v) is None:
+            raise ValueError(
+                "LATENCY_BUCKETS must be comma-separated strictly "
+                f"ascending positive seconds, got {v!r}"
+            )
+        return v
+
 
 def _env(name: str, default: str | None = None) -> str | None:
     v = os.environ.get(name)
@@ -816,7 +910,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       SCALE_UP_KV_FRAC, SCALE_UP_TTFT_MS, SCALE_UP_COOLDOWN_S,
       SCALE_DOWN_LOAD, SCALE_DOWN_COOLDOWN_S, SCALE_PERIOD_S,
       TRACE, TRACE_RING, FLIGHT_RING, PROFILE_DIR, LOG_FORMAT,
-      COMPILE_CACHE_DIR, HOST_PREP_DOUBLE.
+      COMPILE_CACHE_DIR, HOST_PREP_DOUBLE, PERF_OBS, PEAK_TFLOPS,
+      LATENCY_BUCKETS, SLO_TTFT_MS, SLO_TBT_MS, SLO_BATCH_TTFT_MS,
+      SLO_BATCH_TBT_MS, SLO_TARGET, SLO_WINDOWS_S, SCALE_UP_SLO_BURN.
     """
     e = dict(os.environ)
     if env:
@@ -847,6 +943,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "journal_dir": "JOURNAL_DIR",
         "journal_fsync": "JOURNAL_FSYNC",
         "compile_cache_dir": "COMPILE_CACHE_DIR",
+        "latency_buckets": "LATENCY_BUCKETS",
+        "slo_windows_s": "SLO_WINDOWS_S",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -913,10 +1011,20 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("scale_down_cooldown_s", "SCALE_DOWN_COOLDOWN_S"),
         ("scale_period_s", "SCALE_PERIOD_S"),
         ("engine_restart_window_s", "ENGINE_RESTART_WINDOW_S"),
+        ("peak_tflops", "PEAK_TFLOPS"),
+        ("slo_ttft_ms", "SLO_TTFT_MS"),
+        ("slo_tbt_ms", "SLO_TBT_MS"),
+        ("slo_batch_ttft_ms", "SLO_BATCH_TTFT_MS"),
+        ("slo_batch_tbt_ms", "SLO_BATCH_TBT_MS"),
+        ("slo_target", "SLO_TARGET"),
+        ("scale_up_slo_burn", "SCALE_UP_SLO_BURN"),
     ):
         v = get(var)
         if v is not None:
             kwargs[field] = float(v)
+    v = get("PERF_OBS")
+    if v is not None:
+        kwargs["perf_obs"] = v.lower() not in ("0", "false", "no")
     v = get("PREEMPT")
     if v is not None:
         kwargs["preempt"] = v.lower() not in ("0", "false", "no")

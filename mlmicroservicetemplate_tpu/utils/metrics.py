@@ -12,6 +12,8 @@ serving path never gains a hard dependency.
 
 from __future__ import annotations
 
+import os
+
 try:
     from prometheus_client import (
         CONTENT_TYPE_LATEST,
@@ -48,8 +50,40 @@ except Exception:  # pragma: no cover - prometheus_client is installed here
         return b"# prometheus_client not installed\n"
 
 
+# Latency histogram buckets (r20, the r11 honest negative closed):
+# the defaults extend past 10 s — on the 1-vCPU CI box,
+# stream_ttft/tbt p99 saturated the old 10 s top bucket and
+# hist_pctile could only report "≥ 10 s".  The LATENCY_BUCKETS env
+# knob overrides the whole set (comma-separated ascending seconds,
+# validated strictly in ServiceConfig; parsed leniently here because
+# metrics imports before config validation and a bad env var must
+# not break `import metrics` for a test process).
+_DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+def parse_buckets(spec: str | None) -> tuple[float, ...] | None:
+    """Comma-separated ascending positive bucket edges, or None when
+    unset/invalid (callers fall back to the defaults; ServiceConfig's
+    validator is the strict gate that rejects garbage at boot)."""
+    if not spec:
+        return None
+    try:
+        vals = tuple(float(x) for x in spec.split(",") if x.strip())
+    except ValueError:
+        return None
+    if not vals or any(v <= 0 for v in vals) or list(vals) != sorted(
+        set(vals)
+    ):
+        return None
+    return vals
+
+
 _LATENCY_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    parse_buckets(os.environ.get("LATENCY_BUCKETS"))
+    or _DEFAULT_LATENCY_BUCKETS
 )
 
 REQUESTS = Counter(
@@ -194,8 +228,8 @@ FLEET_REPLICAS = Gauge(
 FLEET_SCALE_EVENTS = Counter(
     "fleet_scale_events_total",
     "Completed fleet scale events by direction and cause (up: queue | "
-    "kv | ttft | min | rejoin | manual, spawn_failed when the warm "
-    "probe died; down: idle | manual)",
+    "kv | ttft | slo | min | rejoin | manual, spawn_failed when the "
+    "warm probe died; down: idle | manual)",
     ["model", "dir", "cause"],
 )
 FLEET_SCALE_DURATION = Histogram(
@@ -313,9 +347,13 @@ KV_GROWTH_STALLS = Counter(
 # cadence both sit well under 1 ms on direct-attached chips — the
 # whole point of these two series is separating that regime from the
 # ~100 ms relay RTT regime.
+# The fine set keeps its sub-ms resolution but no longer tops out at
+# 10 s (the r11 honest negative: stream_tbt_seconds p99 saturated the
+# top bucket on the 1-vCPU box and the scrape-side percentile could
+# only answer "≥ 10 s").
 _FINE_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
-    0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+    0.1, 0.25, 0.5, 1.0, 2.5, 10.0, 30.0, 120.0,
 )
 DISPATCH_HOST = Histogram(
     "dispatch_host_seconds",
@@ -358,6 +396,51 @@ TBT = Histogram(
     "token-chunk deliveries to one stream after its first chunk) — "
     "the decode-cadence series the chunked-prefill A/B judges",
     ["model"], buckets=_FINE_BUCKETS,
+)
+# -- perf observatory (r20; utils/perfobs.py, docs/observability.md) --
+DEVICE_BUSY = Counter(
+    "device_busy_seconds",
+    "Estimated device-busy seconds by dispatch site, derived from "
+    "submit timestamps + the loop's existing fetch seams (zero extra "
+    "syncs, always on — the production replacement for the TRACE=1 "
+    "block_until_ready attribution mode)",
+    ["model", "site"],
+)
+DEVICE_BUBBLE = Counter(
+    "device_bubble_seconds",
+    "Estimated device idle gaps between attributed busy intervals "
+    "(time the chip sat waiting on host dispatch/prep — the quantity "
+    "the host-side levers shrink)",
+    ["model"],
+)
+MODELED_FLOPS = Counter(
+    "modeled_flops_total",
+    "Modeled FLOPs accrued per dispatched executable kind "
+    "(XLA cost_analysis, analyzed once per executable at the shared "
+    "compile cache — runtime/compile_cache.py)",
+    ["model", "kind"],
+)
+MFU = Gauge(
+    "mfu_estimate",
+    "Rolling model-FLOPs-utilization estimate: modeled FLOP rate over "
+    "peak chip FLOPs (PEAK_TFLOPS knob or device-kind table; 0 when "
+    "the peak is unknown — /debug/perf carries the raw components)",
+    ["model"],
+)
+SLO_TTFT_BURN = Gauge(
+    "slo_ttft_burn_rate",
+    "Per-priority-class TTFT SLO burn rate by window (fast/slow): "
+    "fraction of the error budget (1 - SLO_TARGET) being consumed; "
+    "1.0 = burning exactly at budget, >1 = violating "
+    "(scheduler/policy.SLOTracker; SLO_TTFT_MS knobs)",
+    ["model", "klass", "window"],
+)
+SLO_TBT_BURN = Gauge(
+    "slo_tbt_burn_rate",
+    "Per-priority-class TBT (inter-chunk cadence) SLO burn rate by "
+    "window (fast/slow), same budget arithmetic as slo_ttft_burn_rate "
+    "(SLO_TBT_MS knobs)",
+    ["model", "klass", "window"],
 )
 
 

@@ -55,6 +55,22 @@ if [ "$1" = "--fast" ]; then
     exit 0
 fi
 
+# Perf-regression gate (r20, docs/observability.md): a deterministic
+# tiny workload's STRUCTURAL counters — chunk/prefill dispatch counts,
+# serving-path XLA compiles (must be 0), host syncs per token, staged
+# host-prep activity — diffed against the committed
+# benchmarks/perf_baseline.json.  Wall-clock appears nowhere, so the
+# gate is CPU-noise-immune by construction.  PERF_SMOKE=0 skips;
+# PERF_SMOKE_UPDATE=1 rewrites the baseline (deliberately, in the PR
+# that changes the structure).
+if [ "${PERF_SMOKE:-1}" != "0" ]; then
+    echo "== perf smoke (structural counters vs committed baseline) =="
+    timeout -k 10 240 env JAX_PLATFORMS=cpu PERF_LEDGER="${PERF_LEDGER:-0}" \
+        python scripts/perf_smoke.py || exit 1
+else
+    echo "== perf smoke skipped (PERF_SMOKE=0) =="
+fi
+
 # Chaos tier: the fault-injection/recovery suite (kept OUT of tier-1 by
 # the conftest's chaos->slow propagation) plus a 3-point FAULT_SPEC
 # smoke matrix — one transient, one fatal, one watchdog-cut hang — each

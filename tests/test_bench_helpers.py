@@ -103,3 +103,32 @@ def test_hist_pctile_median_agrees_with_mean_regime():
     h = {"count": 8.0, "sum": 4.0, "buckets": {0.5: 0.0, 1.0: 8.0, math.inf: 8.0}}
     p = hist_pctile(h, 0.99)
     assert 0.5 < p <= 1.0
+
+
+def test_hist_pctile_resolves_past_ten_seconds_with_r20_buckets():
+    """The r11 honest negative, closed (r20): with the old 10 s top
+    bucket a CPU-box p99 could only report "≥ 10 s"; the extended
+    default buckets now interpolate a real value inside (10, 30]."""
+    from mlmicroservicetemplate_tpu.utils import metrics as m
+
+    assert max(m._DEFAULT_LATENCY_BUCKETS) > 10.0
+    assert max(m._FINE_BUCKETS) > 10.0
+    # 9 fast observations + 1 at ~20 s: p99 used to land in +Inf and
+    # report the 10.0 edge; with the extended set it interpolates.
+    buckets = {le: 9.0 for le in m._FINE_BUCKETS if le <= 10.0}
+    buckets[30.0] = 10.0
+    buckets[120.0] = 10.0
+    buckets[math.inf] = 10.0
+    h = {"count": 10.0, "sum": 29.0, "buckets": buckets}
+    p = hist_pctile(h, 0.99)
+    assert 10.0 < p <= 30.0
+
+
+def test_latency_buckets_env_overrides_defaults():
+    from mlmicroservicetemplate_tpu.utils import metrics as m
+
+    assert m.parse_buckets("0.5,1,2,4") == (0.5, 1.0, 2.0, 4.0)
+    # Lenient at import time: garbage falls back to None (defaults) —
+    # ServiceConfig's validator is the strict boot-time gate.
+    assert m.parse_buckets("garbage") is None
+    assert m.parse_buckets("2,1") is None

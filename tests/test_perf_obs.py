@@ -1,0 +1,421 @@
+"""Perf observatory test suite (ISSUE 15, r20).
+
+Pins, per the acceptance criteria:
+
+1. **Zero-overhead-off**: PERF_OBS=0 keeps NO timestamps (no pending
+   submits, no busy intervals, snapshot reports disabled).
+2. **Dispatch-count-unchanged**: the same workload with the layer on
+   vs off issues bit-identical dispatch/fetch counts per site — the
+   estimator adds zero device syncs by construction.
+3. **Cost analysis**: shared-executable FLOPs match a hand-computed
+   tiny-matmul count exactly, and the occupancy MFU arithmetic agrees
+   with the accrued cost-analysis FLOPs to well within 1%.
+4. **Burn-rate math**: SLOTracker windows/budgets with an injected
+   clock; the all-zero default builds no tracker; the governor's
+   SCALE_UP_SLO_BURN signal is off (bit-identical) when unset.
+5. **Metrics surface**: every new series produces real samples after a
+   smoke workload (the declaration-introspection pin in
+   test_metrics_surface.py covers presence; this covers samples).
+6. **graftlint perf-capture**: timestamp-capture calls outside a
+   dispatch_guard-riding function are findings.
+7. **Fleet**: /debug/engine?all=1 merges every replica's flight ring
+   into one replica-tagged timeline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import textwrap
+
+import numpy as np
+import pytest
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+from mlmicroservicetemplate_tpu.scheduler.policy import (
+    BATCH,
+    INTERACTIVE,
+    ScalingGovernor,
+    SLOTracker,
+)
+from mlmicroservicetemplate_tpu.utils import metrics, perfobs
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import tiny_gpt_bundle
+
+
+def _cfg(**kw) -> ServiceConfig:
+    base = dict(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(8,), max_decode_len=16, stream_chunk_tokens=4,
+        max_streams=2, stream_pipeline=1,
+    )
+    base.update(kw)
+    return ServiceConfig(**base)
+
+
+def _run_streams(cdl, n: int = 2) -> int:
+    async def one(seed: int):
+        feats = {
+            "input_ids": np.arange(1, 9, dtype=np.int32) + seed,
+            "length": np.int32(8),
+            "max_tokens": 16,
+        }
+        out = []
+        async for chunk in cdl.submit_stream(feats):
+            out.extend(chunk.tolist())
+        return out
+
+    async def drive():
+        return [await one(i) for i in range(n)]
+
+    outs = asyncio.run(drive())
+    return sum(len(o) for o in outs)
+
+
+def _workload(cfg) -> tuple:
+    bundle = tiny_gpt_bundle()
+    engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    cdl = ContinuousDecodeLoop(engine, cfg)
+    cdl.warm()
+    try:
+        tokens = _run_streams(cdl)
+    finally:
+        cdl.stop()
+    return engine, cdl, tokens
+
+
+# ---------------------------------------------------------------------------
+# 1 + 2: zero-overhead-off and dispatch-count pins
+
+
+def test_perf_obs_off_keeps_no_timestamps():
+    engine, cdl, tokens = _workload(_cfg(perf_obs=False))
+    assert tokens == 32
+    snap = engine.perf.snapshot()
+    assert snap["enabled"] is False
+    # The pin: nothing was captured — no pending submits, no busy
+    # intervals, no completion samples, no bubble.
+    assert engine.perf._pending == {}
+    assert snap["completion_samples"] == 0
+    assert snap["device_busy_total_s"] == 0.0
+    assert snap["device_bubble_s"] == 0.0
+    perfobs.configure(True)  # restore the process default for later tests
+
+
+def test_dispatch_counts_identical_with_layer_on():
+    """The acceptance pin: always-on attribution adds ZERO device
+    syncs — per-site dispatch counts are bit-identical on vs off."""
+    eng_on, cdl_on, tok_on = _workload(_cfg(perf_obs=True))
+    eng_off, cdl_off, tok_off = _workload(_cfg(perf_obs=False))
+    perfobs.configure(True)
+    assert tok_on == tok_off == 32
+    counts_on = {s: v[0] for s, v in eng_on.dispatch_stats.items()}
+    counts_off = {s: v[0] for s, v in eng_off.dispatch_stats.items()}
+    assert counts_on == counts_off, (
+        f"perf layer changed dispatch counts: {counts_on} vs {counts_off}"
+    )
+    assert cdl_on.chunk_dispatches == cdl_off.chunk_dispatches
+    assert cdl_on.prefill_dispatches == cdl_off.prefill_dispatches
+    # And the on-engine actually measured something, with every
+    # submit closed by a fetch seam (nothing leaks).
+    snap = eng_on.perf.snapshot()
+    assert snap["completion_samples"] > 0
+    assert snap["device_busy_total_s"] > 0
+    assert snap["pending_dispatches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 3: cost analysis + MFU arithmetic
+
+
+def test_cost_analysis_matches_hand_computed_flops():
+    import jax
+    import jax.numpy as jnp
+
+    from mlmicroservicetemplate_tpu.runtime.compile_cache import (
+        shared_executable,
+    )
+
+    perfobs.configure(True)
+
+    class _Bundle:
+        name = "costmodel-matmul"
+
+    b = _Bundle()
+    fn = shared_executable(
+        "matmul", b, object(), lambda: jax.jit(lambda x, y: x @ y)
+    )
+    m, k, n = 8, 16, 32
+    x = jnp.ones((m, k), jnp.float32)
+    y = jnp.ones((k, n), jnp.float32)
+    before = perfobs.book_totals(b.name)["flops"]
+    for _ in range(3):
+        np.asarray(fn(x, y))
+    got = perfobs.book_totals(b.name)["flops"] - before
+    # XLA's HLO cost analysis counts a dot as 2·M·K·N flops.
+    assert got == pytest.approx(3 * 2 * m * k * n)
+
+
+def test_mfu_estimate_agrees_with_cost_analysis_flops():
+    """The acceptance pin: the MFU estimate is the accrued
+    cost-analysis FLOPs over elapsed × peak, to within 1%."""
+    now = [100.0]
+    occ = perfobs.DeviceOccupancy(
+        "mfu-model", enabled=True, peak_flops=1e9, clock=lambda: now[0]
+    )
+    flops_per_dispatch = 2_000_000.0
+    for i in range(5):
+        perfobs.note_cost("mfu-model", "chunk", flops_per_dispatch, 0.0)
+        occ.on_guard("chunk", now[0], now[0] + 0.001)
+        now[0] += 0.2
+        occ.note_complete("chunk")
+    snap = occ.snapshot()
+    assert snap["modeled_flops_total"] >= 5 * flops_per_dispatch
+    elapsed = snap["elapsed_s"]
+    expected_epoch_mfu = snap["modeled_flops_total"] / elapsed / 1e9
+    assert snap["mfu_epoch"] == pytest.approx(expected_epoch_mfu, rel=0.01)
+    # The rolling estimate covers the whole (short) run here, so it
+    # must agree with the accrued-FLOP rate too.
+    assert snap["mfu_estimate"] == pytest.approx(
+        expected_epoch_mfu, rel=0.25
+    )
+
+
+def test_occupancy_busy_bubble_and_linearity():
+    now = [0.0]
+    occ = perfobs.DeviceOccupancy("occ-model", clock=lambda: now[0])
+    # A prefill_chunk window with no fetch of its own, then a chunk.
+    occ.on_guard("prefill_chunk", 0.0, 0.01)
+    occ.on_guard("chunk", 0.02, 0.03)
+    now[0] = 0.5
+    occ.note_complete("chunk")  # linearity closes the window too
+    snap = occ.snapshot()
+    assert snap["pending_dispatches"] == 0
+    assert snap["completion_samples"] == 1
+    # Busy interval [0.0, 0.5] split across both sites.
+    assert snap["device_busy_total_s"] == pytest.approx(0.5)
+    assert set(snap["device_busy_s"]) == {"chunk", "prefill_chunk"}
+    # A later idle gap becomes bubble.
+    occ.on_guard("chunk", 1.5, 1.51)
+    now[0] = 1.8
+    occ.note_complete("chunk")
+    snap = occ.snapshot()
+    assert snap["device_bubble_s"] == pytest.approx(1.0)  # 0.5 → 1.5
+    assert snap["device_busy_total_s"] == pytest.approx(0.8)
+
+
+def test_prep_overlap_accrues_only_while_device_busy():
+    occ = perfobs.DeviceOccupancy("prep-model", clock=lambda: 0.0)
+    occ.on_guard("prep", 0.0, 0.1)  # nothing in flight: no overlap
+    occ.on_guard("chunk", 0.0, 0.01)
+    occ.on_guard("prep", 0.1, 0.3)  # chunk in flight: overlap
+    snap = occ.snapshot()
+    assert snap["prep_host_s"] == pytest.approx(0.3)
+    assert snap["prep_overlap_s"] == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# 4: SLO burn-rate math
+
+
+def _tracker(clock, **kw):
+    objectives = {
+        ("ttft", INTERACTIVE): 0.5,
+        ("tbt", INTERACTIVE): 0.1,
+        ("ttft", BATCH): 5.0,
+    }
+    return SLOTracker(
+        "slo-model", objectives, target=kw.pop("target", 0.9),
+        windows_s=kw.pop("windows_s", (60.0, 600.0)), clock=clock,
+    )
+
+
+def test_slo_burn_rate_math_with_injected_clock():
+    now = [1000.0]
+    t = _tracker(lambda: now[0])
+    # 8 good + 2 bad TTFTs: bad fraction 0.2, budget 0.1 → burn 2.0.
+    for _ in range(8):
+        t.note("ttft", INTERACTIVE, 0.1)
+    for _ in range(2):
+        t.note("ttft", INTERACTIVE, 1.0)
+    assert t.burn_rate("ttft", INTERACTIVE) == pytest.approx(2.0)
+    # All good → burn 0; no samples → burn 0.
+    assert t.burn_rate("tbt", INTERACTIVE) == 0.0
+    assert t.burn_rate("ttft", BATCH) == 0.0
+    assert t.worst_burn() == pytest.approx(2.0)
+    # The fast window forgets: advance past it, note one good sample —
+    # the old bad samples age out of the fast window but stay in slow.
+    now[0] += 120.0
+    t.note("ttft", INTERACTIVE, 0.1)
+    assert t.burn_rate("ttft", INTERACTIVE, 60.0) == 0.0
+    assert t.burn_rate("ttft", INTERACTIVE, 600.0) == pytest.approx(
+        (2 / 11) / 0.1
+    )
+    # Gauges carry the same numbers.
+    t.export_gauges()
+    if metrics.HAVE_PROM:
+        text = metrics.render()[0].decode()
+        assert 'slo_ttft_burn_rate{klass="interactive",model="slo-model",window="fast"} 0.0' in text
+
+
+def test_slo_tracker_disabled_by_default():
+    assert SLOTracker.from_cfg("m", _cfg()) is None
+    t = SLOTracker.from_cfg("m", _cfg(slo_ttft_ms=500.0))
+    assert t is not None
+    assert t.objectives == {("ttft", INTERACTIVE): 0.5}
+
+
+def test_governor_slo_signal_off_is_bit_identical():
+    base = dict(live=2, queued=0, active=1, slots=8)
+    g0 = ScalingGovernor(1, 4, clock=lambda: 0.0)
+    g1 = ScalingGovernor(1, 4, up_slo_burn=2.0, clock=lambda: 0.0)
+    # Unset (default 0): a huge burn value changes nothing.
+    assert g0.decide(**base, slo_burn=99.0) == (None, "steady")
+    # Set: the same inputs scale up with cause "slo".
+    assert g1.decide(**base, slo_burn=2.5) == ("up", "slo")
+    assert g1.decide(**base, slo_burn=1.9) == (None, "steady")
+
+
+# ---------------------------------------------------------------------------
+# 5: metrics surface samples after a real workload
+
+
+def test_new_series_sample_after_workload():
+    if not metrics.HAVE_PROM:
+        pytest.skip("prometheus_client not installed")
+    engine, cdl, tokens = _workload(
+        _cfg(perf_obs=True, slo_ttft_ms=60000.0, slo_tbt_ms=60000.0,
+             peak_tflops=0.001)
+    )
+    assert tokens == 32
+    text = metrics.render()[0].decode()
+    assert 'device_busy_seconds_total{model="gpt2",site="chunk"}' in text
+    assert 'modeled_flops_total{kind="gen_chunk",model="gpt2"}' in text
+    assert "mfu_estimate{" in text
+    assert 'slo_ttft_burn_rate{klass="interactive",model="gpt2"' in text
+    assert 'slo_tbt_burn_rate{klass="interactive",model="gpt2"' in text
+    # /status.perf + /debug/perf building blocks.
+    snap = engine.perf.snapshot()
+    assert snap["modeled_flops_total"] > 0
+    assert cdl.slo is not None
+    s = cdl.slo.snapshot()
+    assert s["burn"]["ttft:interactive:fast"] == 0.0  # 60 s budget: all good
+
+
+def test_latency_buckets_knob_validated_and_extended_defaults():
+    # Defaults extend past the old 10 s ceiling (the r11 negative).
+    assert max(metrics._DEFAULT_LATENCY_BUCKETS) > 10.0
+    assert max(metrics._FINE_BUCKETS) > 10.0
+    # Strict config validation...
+    with pytest.raises(Exception):
+        ServiceConfig(latency_buckets="1,0.5")  # not ascending
+    with pytest.raises(Exception):
+        ServiceConfig(latency_buckets="0,-1")
+    assert ServiceConfig(
+        latency_buckets="0.1,1,10,60"
+    ).latency_buckets == "0.1,1,10,60"
+    # ...and the lenient import-time parser mirrors it.
+    assert metrics.parse_buckets("1,0.5") is None
+    assert metrics.parse_buckets("0.1,1,60") == (0.1, 1.0, 60.0)
+    assert metrics.parse_buckets(None) is None
+
+
+# ---------------------------------------------------------------------------
+# 6: graftlint perf-capture extension
+
+
+def test_graftlint_perf_capture_positive_and_clean():
+    from tools.graftlint import lint_source
+
+    STREAMS_REL = "mlmicroservicetemplate_tpu/engine/streams.py"
+    bad = textwrap.dedent("""
+        class Loop:
+            def random_place(self):
+                # capture with no dispatch in sight: invented timestamp
+                self.engine.perf.note_complete("chunk")
+    """)
+    fs = [f for f in lint_source(bad, STREAMS_REL, "dispatch-guard")
+          if not f.waived]
+    assert len(fs) == 1 and "perf capture" in fs[0].message
+    good = textwrap.dedent("""
+        import jax
+
+        class Loop:
+            def _deliver_oldest(self):
+                fetched = self.engine.dispatch_guard(
+                    "fetch", lambda: jax.device_get(self._inflight[0])
+                )
+                self.engine.perf.note_complete("chunk")
+    """)
+    assert not [f for f in lint_source(good, STREAMS_REL, "dispatch-guard")
+                if not f.waived]
+
+
+# ---------------------------------------------------------------------------
+# 7: fleet — shared tracker + /debug/engine?all=1 merged timeline
+
+
+def test_fleet_debug_engine_all_merges_replicas():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from mlmicroservicetemplate_tpu.api import build_app
+    from mlmicroservicetemplate_tpu.scheduler import Batcher
+
+    cfg = _cfg(fleet_replicas=2, slo_ttft_ms=60000.0)
+    bundle = tiny_gpt_bundle()
+    engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
+    batcher = Batcher(engine, cfg)
+    # The fleet shares ONE tracker (a degraded replica must not hide
+    # behind healthy siblings' windows).
+    fleet = batcher.fleet
+    assert fleet is not None
+    slos = {id(rep.cdl.slo) for rep in fleet.replicas}
+    assert len(slos) == 1 and None not in slos
+
+    async def main():
+        app = build_app(cfg, bundle, engine, batcher)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for _ in range(200):
+                if (await client.get("/readyz")).status == 200:
+                    break
+                await asyncio.sleep(0.05)
+            # ≤ 8 byte-level tokens: stays inside the seq bucket so the
+            # stream runs through the continuous loop (flight frames).
+            r = await client.post(
+                "/predict", json={"text": "hifleet", "stream": True},
+            )
+            assert r.status == 200
+            import json as _json
+
+            async for line in r.content:
+                if _json.loads(line).get("done"):
+                    break
+            r = await client.get("/debug/engine?all=1")
+            assert r.status == 200
+            merged = await r.json()
+            r = await client.get("/debug/perf")
+            assert r.status == 200
+            perf = await r.json()
+            r = await client.get("/status")
+            status = await r.json()
+            return merged, perf, status
+        finally:
+            await client.close()
+
+    merged, perf, status = asyncio.run(main())
+    assert merged["fleet"] is True
+    assert set(merged["replicas"]) == {"0", "1"}
+    tags = {e["replica"] for e in merged["timeline"] if "replica" in e}
+    assert tags, "merged timeline carries no replica-tagged entries"
+    # Timeline is time-sorted.
+    ts = [e["t"] for e in merged["timeline"] if "t" in e]
+    assert ts == sorted(ts)
+    # Fleet perf rollup + /status.perf surfaces.
+    assert perf["replicas"] == 2
+    assert set(perf["per_replica"]) == {"0", "1"}
+    assert "slo" in perf
+    assert "perf" in status and "busy_ratio" in status["perf"]
