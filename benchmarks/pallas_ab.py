@@ -1,16 +1,28 @@
-"""Pallas fused-attention A/B: device time with vs without the kernel.
+"""Pallas kernel A/B: device time with vs without / tuned vs default.
 
-Round-1 verdict: the kernel shipped with no measured win.  This
-measures it with the two-scan-length method (benchmarks/timing.py):
-scans of K and 2K forwards inside one executable are differenced, so
-the per-dispatch relay round-trip cancels exactly — the round-2 weak
-#1 (subtracting a separately-sampled ±10 ms RTT) is gone, and REPS=5.
+Round-1 verdict: the fused-attention kernel shipped with no measured
+win.  This measures it with the two-scan-length method
+(benchmarks/timing.py): scans of K and 2K forwards inside one
+executable are differenced, so the per-dispatch relay round-trip
+cancels exactly — the round-2 weak #1 (subtracting a
+separately-sampled ±10 ms RTT) is gone, and REPS=5.
 
     python benchmarks/pallas_ab.py          # TPU; prints one JSON line
 
 Configs measured: BERT-base (B=32, S=512) — the shape the verdict asked
 for — and the T5-small encoder (B=8, S=512) now that the kernel takes
 the rel-pos bias.
+
+Round 21 adds the **paged decode autotuner A/B** (tuned vs default
+variant of ``ops/paged_attention.paged_decode_attention``, dense and
+int8 caches): ``ensure_tuned`` runs its verify-then-time sweep and the
+per-variant timings + the winner's delta against the ``b1`` default
+are recorded, along with the autotuner's decision counters — the
+structural half rides the PERF_LEDGER via ``run_all.py``.  On a
+non-TPU backend the fused sections are skipped (no CPU lowering) and
+the paged sweep runs interpret-mode: timings are then *relative* CPU
+numbers, honest only about kernel-vs-kernel structure, and the JSON
+says so (``backend: cpu-interpret``).
 """
 
 from __future__ import annotations
@@ -23,6 +35,60 @@ import numpy as np
 SCAN_ITERS = int(os.environ.get("SCAN_ITERS", "8"))
 
 
+def paged_decode_ab() -> dict:
+    """Tuned-vs-default paged-decode sweep at a llama-shaped decode
+    problem (GQA n_rep=2), dense and int8; returns the sweep detail
+    plus the autotuner counters."""
+    import jax
+
+    from mlmicroservicetemplate_tpu.ops import autotune
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    if interpret:
+        # CPU interpret mode: same kernel code path, toy shapes so the
+        # sweep stays in seconds; numbers are structural, not absolute.
+        shapes = dict(b=2, kvh=2, n_rep=2, d=16, block_size=8, t=8)
+        dtype = "float32"
+    else:
+        shapes = dict(b=8, kvh=4, n_rep=2, d=64, block_size=16, t=32)
+        dtype = "bfloat16"
+
+    class _Bundle:
+        name = "pallas_ab"
+
+    out: dict = {
+        "backend": "cpu-interpret" if interpret else backend,
+        "shapes": dict(shapes, dtype=dtype),
+    }
+    autotune.clear()
+    for quant, label in ((False, "dense"), (True, "int8")):
+        winner = autotune.ensure_tuned(
+            "paged_decode", _Bundle(), None, **shapes, dtype=dtype,
+            quant=quant, interpret=interpret, table_path=None,
+        )
+        stats = autotune.stats()
+        key = autotune.tune_key("paged_decode", **shapes, dtype=dtype,
+                                quant=quant)
+        sweep = stats["sweeps"].get(key, {})
+        per = sweep.get("per_call_us", {})
+        default_us = per.get("b1")
+        tuned_us = per.get(winner)
+        out[label] = {
+            "variant": winner,
+            "default_us": default_us,
+            "tuned_us": tuned_us,
+            "speedup": (
+                round(default_us / tuned_us, 3)
+                if default_us and tuned_us else None
+            ),
+            "noisy": sweep.get("noisy", False),
+            "per_variant_us": per,
+        }
+    out["autotune"] = autotune.stats()["counts"]
+    return out
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -32,6 +98,31 @@ def main() -> None:
     from mlmicroservicetemplate_tpu.models import t5 as t5_mod
 
     out: dict = {"scan_iters": SCAN_ITERS, "method": "two-scan-length (K vs 2K)"}
+
+    # -- paged decode: tuned vs default variant (r21) -------------------
+    if os.environ.get("PAGED_AB", "1").lower() not in ("0", "false", "no"):
+        out["paged_decode"] = paged_decode_ab()
+        try:
+            from perf_ledger import append_row
+
+            pd = out["paged_decode"]
+            append_row("pallas_paged_ab", {
+                "autotune": pd["autotune"],
+                "paged_variant_dense": pd["dense"]["variant"],
+                "paged_variant_int8": pd["int8"]["variant"],
+                "paged_speedup_dense": pd["dense"]["speedup"],
+                "paged_speedup_int8": pd["int8"]["speedup"],
+            }, extra={"backend": pd["backend"]})
+        except Exception as e:
+            print(f"paged A/B ledger append failed: {e}")
+
+    if jax.default_backend() != "tpu":
+        # The fused-attention kernels have no CPU lowering; the paged
+        # section above already ran interpret-mode.  Record the skip
+        # honestly rather than crash or fake a number.
+        out["fused_skipped"] = "backend!=tpu (no CPU lowering)"
+        print(json.dumps(out))
+        return
 
     # -- BERT-base, B=32, S=512 (the verdict's shape) -------------------
     b, s = 32, 512

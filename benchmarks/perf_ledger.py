@@ -78,6 +78,16 @@ def structural_counters(engine, cdl=None) -> dict:
         snap = perf.snapshot()
         out["modeled_flops_total"] = snap.get("modeled_flops_total", 0.0)
         out["perf_pending_dispatches"] = snap.get("pending_dispatches", 0)
+    try:
+        from mlmicroservicetemplate_tpu.ops import autotune
+
+        counts = autotune.stats()["counts"]
+        if any(counts.values()):
+            out["autotune_variants_swept"] = counts["timed"]
+            out["autotune_installs"] = counts["installs"]
+            out["autotune"] = counts
+    except Exception:
+        pass
     return out
 
 

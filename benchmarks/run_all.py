@@ -220,6 +220,17 @@ async def main() -> None:
             check=False,
         )
 
+    # Pallas kernels (round-21 tentpole): the paged-decode autotuner's
+    # tuned-vs-default sweep (dense + int8; interpret-mode on CPU) plus
+    # the r1 fused-attention A/B on TPU — appends its own structural
+    # ledger row (winner variant, speedups, autotuner counters).
+    # PALLAS_AB=0 skips.
+    if os.environ.get("PALLAS_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "pallas_ab.py")],
+            check=False,
+        )
+
     # Elastic autoscaling (round-17 tentpole): goodput + shed rate +
     # scale-event latency under a burst→lull→burst arrival curve,
     # static R=1 vs elastic [1..3] (donor-broadcast scale-up,

@@ -1421,6 +1421,18 @@ async def handle_status(request: web.Request) -> web.Response:
                 for site, a in engine.dispatch_attribution().items()
             },
         }
+        # Pallas kernel selection (PALLAS_AUTOTUNE / PALLAS_VARIANT;
+        # docs/kernel_tuning.md): the active variant ("" = default
+        # kernel) and the autotuner's decision counters.
+        kv_var = getattr(cdl, "kernel_variant", "")
+        if kv_var or getattr(
+                getattr(engine, "cfg", None), "pallas_autotune", False):
+            from ..ops import autotune
+
+            a_stats = autotune.stats()
+            body["decode"]["kernel_variant"] = kv_var
+            body["decode"]["autotune"] = a_stats["counts"]
+            body["decode"]["autotune_table"] = a_stats["table"]
     tier = getattr(engine, "kv_host", None)
     if cdl is not None and tier is not None and tier.enabled:
         # Host KV tier (KV_HOST_BUDGET_MB; docs/kv-tiering.md): swap

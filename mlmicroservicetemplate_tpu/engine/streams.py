@@ -445,6 +445,11 @@ class ContinuousDecodeLoop:
         )
         self._window_jit = None
         self._paged_window_jit = None
+        # Active Pallas decode-kernel variant ("" = default kernel).
+        # Resolved once at warm time (_autotune_kernel) BEFORE the
+        # paged executables trace; also the statics entry that keys
+        # those executables in the shared cache (docs/kernel_tuning.md).
+        self.kernel_variant = ""
         # Window observability (/status.decode + bench window stats).
         self.window_dispatches = 0
         self.window_chunks = 0
@@ -2945,6 +2950,10 @@ class ContinuousDecodeLoop:
                 "paged_chunk",
                 lambda: jax.jit(self.engine.bundle.paged_chunk_fn,
                                 static_argnums=(3, 4)),
+                # The traced program embeds the tuned kernel variant
+                # (resolved at trace time via ops/autotune.lookup) —
+                # replicas tuned differently must not share a wrapper.
+                statics=(self.kernel_variant,),
             )
         return self._paged_chunk
 
@@ -3944,6 +3953,7 @@ class ContinuousDecodeLoop:
                     "paged_window",
                     lambda: jax.jit(self.engine.bundle.paged_window_fn,
                                     static_argnums=(3, 4, 5)),
+                    statics=(self.kernel_variant,),  # see _paged_chunk_fn
                 )
             return self._paged_window_jit
         if self._window_jit is None:
@@ -4598,6 +4608,46 @@ class ContinuousDecodeLoop:
         # Reset to all-dead so warm inserts never leak into serving.
         self._build_empty_state()
 
+    def _autotune_kernel(self) -> None:
+        """Warm-time Pallas kernel-variant resolution (ops/autotune.py,
+        docs/kernel_tuning.md).  Runs BEFORE the paged executables
+        below trace: a PALLAS_VARIANT pin is validated and installed,
+        else PALLAS_AUTOTUNE runs the measured sweep (verify-then-time
+        every feasible variant at this loop's exact decode shapes) —
+        either way the winner lands in the process tuning table, where
+        the model's kernel call sites resolve it at trace time, and in
+        the fleet-shared ExecutableCache + persisted table, so replica
+        spawns/rebuilds/replays inherit it with zero extra compiles.
+        No knob set, or the bundle not on the kernel path: no-op,
+        ``self.kernel_variant`` stays "" (the default kernel)."""
+        eng = self.engine
+        bcfg = getattr(eng.bundle, "cfg", None)
+        scfg = getattr(eng, "cfg", None)
+        if not (self.paged and getattr(bcfg, "pallas_decode", False)):
+            return
+        pin = (getattr(scfg, "pallas_variant", None)
+               or getattr(bcfg, "pallas_variant", "") or None)
+        if not (pin or getattr(scfg, "pallas_autotune", False)):
+            return
+        import numpy as np_
+
+        from ..ops import autotune
+        from ..runtime.device import tune_table_default
+
+        path = autotune.default_table_path() or tune_table_default(
+            getattr(scfg, "compile_cache_dir", None))
+        kvh = int(getattr(bcfg, "num_kv_heads", bcfg.num_heads))
+        self.kernel_variant = autotune.ensure_tuned(
+            "paged_decode", eng.bundle, eng.replicas,
+            b=self.n_slots, kvh=kvh,
+            n_rep=int(bcfg.num_heads) // kvh, d=int(bcfg.head_dim),
+            block_size=self.block_size, t=self.nb_max,
+            dtype=str(np_.dtype(eng.bundle.policy.compute_jnp)),
+            quant=bool(getattr(bcfg, "kv_quant", False)),
+            interpret=bool(getattr(bcfg, "pallas_interpret", False)),
+            pin=pin, table_path=path,
+        )
+
     def _warm_paged(self, warm_sampled: bool) -> None:
         """Paged-mode warmup: the paged insert per (wave size × seq
         bucket) and the paged chunk in both sample variants, against
@@ -4612,6 +4662,7 @@ class ContinuousDecodeLoop:
 
         from .kv_blocks import OutOfBlocks, StreamBlocks
 
+        self._autotune_kernel()
         eng = self.engine
         wave_sizes = [1]
         if self.n_slots > 1:

@@ -61,6 +61,18 @@ class GPTConfig:
     ln_eps: float = 1e-5
     eos_id: int = 50256
     pad_id: int = 50256  # GPT-2 has no pad token; eos doubles as pad
+    # Fused Pallas decode over the paged pool (ops/paged_attention):
+    # one grid program per (row, block-group) DMAs exactly the row's
+    # live blocks — no gather_pages materialization.  GPT is MHA
+    # (kvh == num_heads, n_rep == 1), so this is the no-GQA corner of
+    # the same kernel llama serves; token-identical to the gather path
+    # (tests/test_pallas_autotune.py).  Serving-only, no VJP.
+    pallas_decode: bool = False
+    # Variant pin / interpret-mode toggle — same contract as
+    # LlamaConfig (docs/kernel_tuning.md); "" resolves through the
+    # autotuner tuning table at trace time.
+    pallas_variant: str = ""
+    pallas_interpret: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -475,9 +487,23 @@ def _paged_decode_step(
         cv = paged_write_token(state.cache_v[li], table, t, v1[:, 0], bs)
         new_k.append(ck)
         new_v.append(cv)
-        kd = gather_pages(ck, table, bs)
-        vd = gather_pages(cv, table, bs)
-        ctx = mha_attention(q, kd, vd, mask=attn_mask)
+        if cfg.pallas_decode:
+            from ..ops import autotune
+            from ..ops.paged_attention import paged_decode_attention
+
+            vkey = cfg.pallas_variant or autotune.lookup(
+                "paged_decode", b=b, kvh=ck.shape[2], n_rep=1,
+                d=q.shape[3], block_size=bs, t=table.shape[1],
+                dtype=str(q.dtype), quant=False,
+            )
+            ctx = paged_decode_attention(
+                q[:, 0], ck, cv, table, key_valid, bs,
+                interpret=cfg.pallas_interpret, variant=vkey,
+            )[:, None]
+        else:
+            kd = gather_pages(ck, table, bs)
+            vd = gather_pages(cv, table, bs)
+            ctx = mha_attention(q, kd, vd, mask=attn_mask)
         x = x + dense(layer["attn"]["out"], merge_heads(ctx))
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))

@@ -82,6 +82,15 @@ class LlamaConfig:
     # softmax like the jnp path (verified equal in tests/test_ops.py);
     # serving-only, no VJP.
     pallas_decode: bool = False
+    # Kernel-variant pin (ops/paged_attention.Variant grammar, e.g.
+    # "b4-hb"): "" = resolve through the autotuner's tuning table at
+    # trace time (ops/autotune.lookup — the measured winner for this
+    # decode shape, or the default kernel when nothing is tuned).
+    # Registry plumbs PALLAS_VARIANT here; docs/kernel_tuning.md.
+    pallas_variant: str = ""
+    # Run Pallas kernels in interpret mode (CPU serving/CI; TPU runs
+    # compiled Mosaic).  Registry plumbs PALLAS_INTERPRET.
+    pallas_interpret: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -395,15 +404,26 @@ def _cache_attention(cfg: LlamaConfig, q, ck, cv, mask):
     the single-query step runs the fused decode kernel instead: no
     materialized GQA repeat, int8 payloads dequantized in-kernel."""
     if cfg.pallas_decode and q.shape[1] == 1:
+        from ..ops import autotune
         from ..ops.attention import decode_attention
 
         m2 = mask[:, 0, 0, :]  # [B, 1, 1, T] -> [B, T]
-        if isinstance(ck, tuple):
+        quant = isinstance(ck, tuple)
+        kslab = ck[0] if quant else ck
+        vkey = cfg.pallas_variant or autotune.lookup(
+            "decode", b=q.shape[0], kvh=kslab.shape[2],
+            n_rep=q.shape[2] // kslab.shape[2], d=q.shape[3],
+            block_size=0, t=kslab.shape[1], dtype=str(q.dtype), quant=quant,
+        )
+        if quant:
             ctx = decode_attention(
-                q[:, 0], ck[0], cv[0], m2, k_scale=ck[1], v_scale=cv[1]
+                q[:, 0], ck[0], cv[0], m2, k_scale=ck[1], v_scale=cv[1],
+                interpret=cfg.pallas_interpret, variant=vkey,
             )
         else:
-            ctx = decode_attention(q[:, 0], ck, cv, m2)
+            ctx = decode_attention(q[:, 0], ck, cv, m2,
+                                   interpret=cfg.pallas_interpret,
+                                   variant=vkey)
         return ctx[:, None]  # [B, 1, H, D]
     if isinstance(ck, tuple):
         return mha_attention_kv8(
@@ -591,15 +611,26 @@ def _paged_cache_attention(cfg: LlamaConfig, q, ck, cv, table, key_valid,
     Otherwise the row's blocks gather to a dense view and run the
     contiguous path's exact math (token identity by construction)."""
     if cfg.pallas_decode and q.shape[1] == 1:
+        from ..ops import autotune
         from ..ops.paged_attention import paged_decode_attention
 
-        if isinstance(ck, tuple):
+        quant = isinstance(ck, tuple)
+        kpool = ck[0] if quant else ck
+        vkey = cfg.pallas_variant or autotune.lookup(
+            "paged_decode", b=q.shape[0], kvh=kpool.shape[2],
+            n_rep=q.shape[2] // kpool.shape[2], d=q.shape[3],
+            block_size=bs, t=table.shape[1], dtype=str(q.dtype), quant=quant,
+        )
+        if quant:
             ctx = paged_decode_attention(
                 q[:, 0], ck[0], cv[0], table, key_valid, bs,
                 k_scale=ck[1], v_scale=cv[1],
+                interpret=cfg.pallas_interpret, variant=vkey,
             )
         else:
-            ctx = paged_decode_attention(q[:, 0], ck, cv, table, key_valid, bs)
+            ctx = paged_decode_attention(q[:, 0], ck, cv, table, key_valid,
+                                         bs, interpret=cfg.pallas_interpret,
+                                         variant=vkey)
         return ctx[:, None]
     from ..ops.paged_attention import gather_pages
 

@@ -271,6 +271,40 @@ def _decode_position_budget(svc_cfg, max_position: int, p_len: int,
     return max_prompt
 
 
+def _pallas_knobs(svc_cfg) -> dict:
+    """Kernel-selection knobs every decoder-only family plumbs into its
+    (frozen) model config at build time (docs/kernel_tuning.md):
+    ``PALLAS_VARIANT`` pins one autotuner variant (validated here — a
+    typo'd pin must fail at boot, not at first trace) and
+    ``PALLAS_INTERPRET`` runs the kernels in interpret mode, which also
+    lifts the TPU backend gate so CPU CI/serving can exercise the real
+    kernel path end-to-end."""
+    out: dict = {}
+    interp = bool(getattr(svc_cfg, "pallas_interpret", False))
+    if interp:
+        out["pallas_interpret"] = True
+    pin = getattr(svc_cfg, "pallas_variant", None)
+    if pin:
+        from ..ops.paged_attention import parse_variant
+
+        parse_variant(pin)
+        out["pallas_variant"] = pin
+    return out
+
+
+def _pallas_backend_ok(svc_cfg) -> bool:
+    """The fused decode kernels lower on TPU only; interpret mode is
+    the explicit escape hatch (CPU CI, the pallas_ab bench)."""
+    if getattr(svc_cfg, "pallas_interpret", False):
+        return True
+    try:
+        import jax as _jax
+
+        return _jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def _tp_placement(svc_cfg, model_cfg, family: str):
     """TP=<n> → a TensorParallelSet factory over a ('replica','tp')
     mesh with the family's Megatron param spec; None when TP is off.
@@ -542,8 +576,26 @@ def _build_gpt(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     from .common import cast_pytree
 
     tokenizer = build_tokenizer(svc_cfg.tokenizer_path, for_t5=True)
+    # Fused paged-decode kernel (MHA corner of the llama kernel):
+    # USE_PALLAS_DECODE opt-in, TPU-or-interpret gated.  The paged
+    # kernel's VMEM footprint is per block-group, not per slab, so the
+    # whole-slab fit gate doesn't apply — the autotuner's cost model
+    # (ops/autotune.paged_vmem_bytes) bounds each variant instead.
+    import os as _os
+
+    gpt_pallas: dict = dict(_pallas_knobs(svc_cfg))
+    env_pd = _os.environ.get("USE_PALLAS_DECODE", "").lower()
+    if env_pd in ("1", "true", "yes"):
+        if _pallas_backend_ok(svc_cfg):
+            gpt_pallas["pallas_decode"] = True
+        else:
+            log.warning(
+                "USE_PALLAS_DECODE requested but unavailable (backend!="
+                "tpu and PALLAS_INTERPRET off); using gather_pages+mha"
+            )
     cfg = gpt_mod.GPTConfig(
-        eos_id=int(tokenizer.eos_id), pad_id=int(tokenizer.pad_id)
+        eos_id=int(tokenizer.eos_id), pad_id=int(tokenizer.pad_id),
+        **gpt_pallas,
     )
     # A tokenizer that can emit ids past the checkpoint's embedding
     # table would hit jnp.take's silent clamp (confidently wrong
@@ -720,8 +772,6 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
     if want_pd:
         import math as _math
 
-        import jax as _jax
-
         from ..ops.attention import decode_kernel_fits
 
         # Worst-case cache width this deployment can reach.  The
@@ -743,11 +793,7 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
         t_est = p_est + max(svc_cfg.seq_buckets) + int(
             _math.ceil(svc_cfg.max_decode_len / chunk) * chunk
         )
-        try:
-            on_tpu = _jax.default_backend() == "tpu"
-        except Exception:
-            on_tpu = False
-        if on_tpu and decode_kernel_fits(
+        if _pallas_backend_ok(svc_cfg) and decode_kernel_fits(
             t_est, probe.num_kv_heads, probe.head_dim
         ):
             overrides["pallas_decode"] = True
@@ -757,6 +803,7 @@ def _build_llama(svc_cfg, policy: DtypePolicy) -> ModelBundle:
                 "(backend!=tpu or slab exceeds VMEM at T=%d); using the "
                 "jnp cache-attention path", t_est,
             )
+    overrides.update(_pallas_knobs(svc_cfg))
     cfg = llama_mod.LlamaConfig(**overrides)
 
     max_id = int(getattr(tokenizer, "max_token_id",

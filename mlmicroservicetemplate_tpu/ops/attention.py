@@ -29,8 +29,32 @@ import jax.numpy as jnp
 # The kernel materializes full [S, S] f32 scores (plus [S, S] bias for
 # T5) in VMEM per grid step — the single-block regime.  Past this
 # sequence length the block no longer fits and compiles would fail at
-# warmup, so default-on falls back to the jnp path instead.
+# warmup, so default-on falls back to the jnp path instead.  Default
+# for the PALLAS_SINGLE_BLOCK_MAX_SEQ env knob (validated range in
+# ``single_block_max_seq``; mirrored by ServiceConfig so a typo'd
+# value fails at boot).
 PALLAS_SINGLE_BLOCK_MAX_SEQ = 512
+
+
+def single_block_max_seq() -> int:
+    """The PALLAS_SINGLE_BLOCK_MAX_SEQ knob, range-checked.  Raises
+    ``ValueError`` on junk — a silent fallback here would flip the
+    kernel off (or VMEM-overflow warmup) with no operator signal."""
+    raw = os.environ.get("PALLAS_SINGLE_BLOCK_MAX_SEQ")
+    if raw in (None, ""):
+        return PALLAS_SINGLE_BLOCK_MAX_SEQ
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"PALLAS_SINGLE_BLOCK_MAX_SEQ={raw!r} is not an integer"
+        ) from None
+    if not 64 <= v <= 8192:
+        raise ValueError(
+            f"PALLAS_SINGLE_BLOCK_MAX_SEQ={v} outside [64, 8192] — the "
+            f"single-block VMEM regime cannot hold more"
+        )
+    return v
 
 
 def use_pallas_attention(max_seq: int | None = None) -> bool:
@@ -57,7 +81,7 @@ def use_pallas_attention(max_seq: int | None = None) -> bool:
         return False
     if env in ("1", "true", "yes"):
         return on_tpu
-    if max_seq is not None and max_seq > PALLAS_SINGLE_BLOCK_MAX_SEQ:
+    if max_seq is not None and max_seq > single_block_max_seq():
         return False
     return on_tpu
 
@@ -154,11 +178,122 @@ def _decode_kernel_kv8(q_ref, k8_ref, ks_ref, v8_ref, vs_ref, mask_ref,
                  scale=scale, kvh=kvh)
 
 
+def _decode_body_v(q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref, *,
+                   scale: float, kvh: int, var):
+    """Variant-parameterized whole-slab body (docs/kernel_tuning.md):
+    the same masked softmax as ``_decode_body`` with the autotuner's
+    axes applied — ``head_batched`` serves every kv head from ONE
+    kvh-batched dot pair, ``native_mxu`` feeds bf16 slabs to the MXU
+    at storage width, ``fold_scales`` keeps int8 payloads unscaled
+    through the dots and folds the scales into scores/probs.  The
+    block axis (``blocks_per_step``) has no meaning here — there is no
+    block table — so the sweep only enumerates these three."""
+    f32 = jnp.float32
+    quant = ks_ref is not None
+    native = var.native_mxu and not quant and (
+        q_ref.dtype == jnp.bfloat16 and k_ref.dtype == jnp.bfloat16
+    )
+
+    def up(x):
+        return x if native else x.astype(f32)
+
+    mask = mask_ref[0]  # [1, T]
+    ks_all = None if ks_ref is None else ks_ref[0].astype(f32)  # [T, KVH]
+    vs_all = None if vs_ref is None else vs_ref[0].astype(f32)
+    k_raw = k_ref[0]  # [T, KVH, D]
+    v_raw = v_ref[0]
+    if quant and not var.fold_scales:
+        k_raw = k_raw.astype(f32) * ks_all[:, :, None]
+        v_raw = v_raw.astype(f32) * vs_all[:, :, None]
+        quant = False
+    elif quant:
+        k_raw = k_raw.astype(f32)
+        v_raw = v_raw.astype(f32)
+
+    if var.head_batched:
+        q = up(q_ref[0])  # [KVH, R, D]
+        s = jax.lax.dot_general(
+            q, up(k_raw),
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=f32,
+        )  # [KVH, R, T]
+        if quant:
+            s = s * jnp.transpose(ks_all)[:, None, :]
+        s = s * scale
+        s = jnp.where(mask[0][None, None, :] != 0, s, f32(-1e9))
+        probs = jax.nn.softmax(s, axis=-1)
+        if quant:
+            probs = probs * jnp.transpose(vs_all)[:, None, :]
+        ctx = jax.lax.dot_general(
+            probs, up(v_raw),
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=f32,
+        )  # [KVH, R, D]
+        o_ref[0] = ctx.astype(o_ref.dtype)
+        return
+
+    for g in range(kvh):
+        q = up(q_ref[0, g])  # [R, D]
+        k = up(k_raw[:, g])  # [T, D]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        if quant:
+            s = s * ks_all[None, :, g]
+        s = s * scale
+        s = jnp.where(mask[0][None, :] != 0, s, f32(-1e9))
+        probs = jax.nn.softmax(s, axis=-1)
+        if quant:
+            probs = probs * vs_all[None, :, g]
+        ctx = jax.lax.dot_general(
+            probs, up(v_raw[:, g]),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        o_ref[0, g] = ctx.astype(o_ref.dtype)
+
+
+def _decode_kernel_v(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale: float,
+                     kvh: int, var):
+    _decode_body_v(q_ref, k_ref, v_ref, None, None, mask_ref, o_ref,
+                   scale=scale, kvh=kvh, var=var)
+
+
+def _decode_kernel_v_kv8(q_ref, k8_ref, ks_ref, v8_ref, vs_ref, mask_ref,
+                         o_ref, *, scale: float, kvh: int, var):
+    _decode_body_v(q_ref, k8_ref, v8_ref, ks_ref, vs_ref, mask_ref, o_ref,
+                   scale=scale, kvh=kvh, var=var)
+
+
 # Per-program VMEM for the whole-slab decode kernel: K+V f32 copies
 # dominate (2·T·KVH·D·4B) on top of the raw blocks.  Guard the
 # auto-enable against configs whose slabs cannot fit, mirroring
-# use_pallas_attention's single-block guard.
+# use_pallas_attention's single-block guard.  Default for the
+# DECODE_KERNEL_VMEM_BUDGET_MB env knob (``decode_vmem_budget_bytes``
+# validates; ServiceConfig mirrors).
 DECODE_KERNEL_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def decode_vmem_budget_bytes() -> int:
+    """The DECODE_KERNEL_VMEM_BUDGET_MB knob in bytes, range-checked.
+    Also the budget ``ops/autotune.py`` filters kernel variants
+    against, so one number bounds both auto-enable and the sweep."""
+    raw = os.environ.get("DECODE_KERNEL_VMEM_BUDGET_MB")
+    if raw in (None, ""):
+        return DECODE_KERNEL_VMEM_BUDGET
+    try:
+        mb = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"DECODE_KERNEL_VMEM_BUDGET_MB={raw!r} is not an integer"
+        ) from None
+    if not 1 <= mb <= 256:
+        raise ValueError(
+            f"DECODE_KERNEL_VMEM_BUDGET_MB={mb} outside [1, 256] — VMEM "
+            f"is ~16 MB/core; budgets past 256 MB are fiction"
+        )
+    return mb * 1024 * 1024
 
 
 def decode_kernel_fits(t: int, kvh: int, d: int) -> bool:
@@ -166,10 +301,12 @@ def decode_kernel_fits(t: int, kvh: int, d: int) -> bool:
     VMEM budget at cache width ``t`` (f32 K+V copies + raw payloads)."""
     f32_copies = 2 * t * kvh * d * 4
     payloads = 2 * t * kvh * d * 4  # bf16/int8 blocks + scales, rounded up
-    return f32_copies + payloads <= DECODE_KERNEL_VMEM_BUDGET
+    return f32_copies + payloads <= decode_vmem_budget_bytes()
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "variant")
+)
 def decode_attention(
     q: jax.Array,  # [B, H, D] — one query per row (the decode step)
     k: jax.Array,  # [B, T, KVH, D] dense, or int8 payload
@@ -179,6 +316,7 @@ def decode_attention(
     v_scale: jax.Array | None = None,
     scale: float | None = None,
     interpret: bool = False,
+    variant: str = "",
 ) -> jax.Array:
     """Decode-side fused attention over the KV cache; returns [B, H, D].
 
@@ -192,6 +330,9 @@ def decode_attention(
     slab + f32 copies ~= 4.6 MB at T=2048, KVH=4, D=64 — comfortable."""
     from jax.experimental import pallas as pl
 
+    from .paged_attention import parse_variant
+
+    var = parse_variant(variant)
     b, h, d = q.shape
     _, t, kvh, _ = k.shape
     n_rep = h // kvh
@@ -202,13 +343,26 @@ def decode_attention(
     kv_spec = pl.BlockSpec((1, t, kvh, d), lambda i: (i, 0, 0, 0))
     mask3 = mask.astype(jnp.int32)[:, None, :]
     mask_spec = pl.BlockSpec((1, 1, t), lambda i: (i, 0, 0))
+    default = not (var.head_batched or var.native_mxu or var.fold_scales)
     if k_scale is None:
-        kernel = functools.partial(_decode_kernel, scale=scale, kvh=kvh)
+        if default:  # the pre-autotuner kernel, bit-identical
+            kernel = functools.partial(_decode_kernel, scale=scale, kvh=kvh)
+        else:
+            kernel = functools.partial(
+                _decode_kernel_v, scale=scale, kvh=kvh, var=var
+            )
         in_specs = [q_spec, kv_spec, kv_spec, mask_spec]
         args = (qg, k, v, mask3)
     else:
         sc_spec = pl.BlockSpec((1, t, kvh), lambda i: (i, 0, 0))
-        kernel = functools.partial(_decode_kernel_kv8, scale=scale, kvh=kvh)
+        if default:
+            kernel = functools.partial(
+                _decode_kernel_kv8, scale=scale, kvh=kvh
+            )
+        else:
+            kernel = functools.partial(
+                _decode_kernel_v_kv8, scale=scale, kvh=kvh, var=var
+            )
         in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, mask_spec]
         args = (
             qg, k, k_scale[..., 0], v, v_scale[..., 0], mask3

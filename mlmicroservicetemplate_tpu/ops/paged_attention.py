@@ -12,16 +12,26 @@ device-side half:
   this view with their EXISTING attention code, which is what makes
   paged decode token-identical to the contiguous layout by
   construction.
-- ``paged_decode_attention``: Pallas kernel — grid ``(B, NB)`` with
+- ``paged_decode_attention``: Pallas kernel — grid ``(B, T/K)`` with
   the block table as a scalar-prefetch operand, so each program DMAs
-  exactly one of its row's blocks HBM->VMEM (the gather never
-  materializes in HBM) and folds it into an online-softmax
+  exactly K of its row's blocks HBM->VMEM (the gather never
+  materializes in HBM) and folds them into an online-softmax
   accumulator, FlashAttention-style.  Composes with ``QUANT_KV=int8``:
   payloads cross at int8 width with per-token-head f32 scales riding
   in their own paged pool, dequantized in VMEM like
   ``ops/attention.decode_attention``.  ``interpret=True`` runs the
   same kernel on CPU (the test/fallback path, same pattern as
   ``parallel/ring.py``).
+
+The kernel is parameterized by a :class:`Variant` (docs/
+kernel_tuning.md): the axes ``ops/autotune.py`` sweeps at warmup.
+Every variant computes the same masked online softmax in the same
+f32 accumulators — variants rearrange WHERE work happens (grid
+folding, head batching, dequant placement, MXU input width), never
+WHAT is accumulated, which is what keeps each one token-identical to
+``paged_attention_ref`` by construction.  The only lossy axis
+(``accbf16`` scratch) is excluded from sweeps and reachable solely
+through an explicit ``PALLAS_VARIANT`` pin.
 
 Sentinel table entries (freed slots) must be clamped to a real block
 id by the caller — out-of-range ids would index past the pool — and
@@ -30,11 +40,90 @@ masked via ``key_valid``; ``gather_pages`` clamps internally.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 
 import jax
 import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Variant:
+    """One point in the paged/slab decode-kernel tuning space.
+
+    - ``blocks_per_step``: K sequential pool blocks folded per grid
+      step — the online-softmax fold then runs over ``K*BS`` keys at
+      once (fewer, larger MXU issues; K must divide the table width so
+      no pad-block path exists).  Paged kernel only; the whole-slab
+      kernel has no block axis.
+    - ``head_batched``: replace the static ``for g in range(kvh)``
+      Python loop with ONE kvh-batched ``dot_general`` so every head's
+      ``n_rep x D`` tile is in flight together (packs full 128-lane
+      registers when a single group's R·D tile is narrow).
+    - ``native_mxu``: feed bf16 payloads to the MXU at storage width
+      (bf16 x bf16 -> f32 via ``preferred_element_type``) instead of
+      upcasting to f32 copies in VMEM first.  Exact — f32 accumulation
+      either way — and a no-op unless q and the pools are bf16.
+    - ``fold_scales``: int8 path — keep payloads UNscaled through the
+      QK/PV dots and fold the per-token-head scales into the score
+      matrix / probability weights instead of dequantizing whole
+      ``[KB, KVH, D]`` tiles ((q·k8)·ks == q·(k8·ks) in real
+      arithmetic; the broadcast multiply shrinks from KB·D to R·KB
+      elements per head).
+    - ``acc_dtype``: online-softmax scratch width.  ``"f32"`` always;
+      ``"bf16"`` is lossy, never enumerated by the sweep, and exists
+      only for an explicit operator pin.
+    """
+
+    blocks_per_step: int = 1
+    head_batched: bool = False
+    native_mxu: bool = False
+    fold_scales: bool = False
+    acc_dtype: str = "f32"
+
+    def key(self) -> str:
+        parts = [f"b{self.blocks_per_step}"]
+        if self.head_batched:
+            parts.append("hb")
+        if self.native_mxu:
+            parts.append("nat")
+        if self.fold_scales:
+            parts.append("fs")
+        if self.acc_dtype != "f32":
+            parts.append(f"acc{self.acc_dtype}")
+        return "-".join(parts)
+
+
+DEFAULT_VARIANT = Variant()
+
+
+def parse_variant(key: str | None) -> Variant:
+    """``"b4-hb-fs"`` -> Variant; ``""``/None -> the default (the
+    pre-autotuner kernel, exactly).  Raises ``ValueError`` on junk so
+    a typo'd ``PALLAS_VARIANT`` pin fails at boot, not at trace."""
+    if not key:
+        return DEFAULT_VARIANT
+    blocks, hb, nat, fs, acc = 1, False, False, False, "f32"
+    for part in key.split("-"):
+        if part.startswith("b") and part[1:].isdigit():
+            blocks = int(part[1:])
+            if blocks < 1:
+                raise ValueError(f"variant {key!r}: blocks_per_step < 1")
+        elif part == "hb":
+            hb = True
+        elif part == "nat":
+            nat = True
+        elif part == "fs":
+            fs = True
+        elif part.startswith("acc") and part[3:] in ("f32", "bf16"):
+            acc = part[3:]
+        else:
+            raise ValueError(
+                f"unknown variant token {part!r} in {key!r} (grammar: "
+                f"b<K>[-hb][-nat][-fs][-accbf16])"
+            )
+    return Variant(blocks, hb, nat, fs, acc)
 
 
 def gather_pages(pool: jax.Array, table: jax.Array, block_size: int) -> jax.Array:
@@ -71,18 +160,117 @@ def scatter_pages(
     return flat.reshape(pool.shape)
 
 
-def _paged_body(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, valid_ref,
-                o_ref, m_scr, l_scr, a_scr, *, scale: float, kvh: int):
-    """One (row, block) grid step: fold block j of row b into the
-    row's online-softmax accumulators; finalize on the last block.
-    Blocks: q/o [1, KVH, R, D]; k/v [1, BS, KVH, D] (int8 payloads
-    with ks/vs [1, BS, KVH] scales on the quantized path); valid
-    [1, 1, BS].  Scratch (f32, VMEM): m/l [KVH, R], acc [KVH, R, D] —
-    persistent across the sequential block axis, reset at j == 0."""
+def _fold_block(q_ref, k_blk, ks_blk, v_blk, vs_blk, valid, m_scr, l_scr,
+                a_scr, *, scale: float, kvh: int, var: Variant):
+    """Fold one [KB, KVH, D] key/value block into the online-softmax
+    accumulators.  ``k_blk``/``v_blk`` are raw payloads (f32/bf16, or
+    int8 when ``ks_blk``/``vs_blk`` carry the [KB, KVH] f32 scales);
+    ``valid`` is the block's [KB] mask.  Scratch m/l [KVH, R] and
+    acc [KVH, R, D] read/write in ``var.acc_dtype``."""
+    f32 = jnp.float32
+    quant = ks_blk is not None
+    native = var.native_mxu and not quant and (
+        q_ref.dtype == jnp.bfloat16 and k_blk.dtype == jnp.bfloat16
+    )
+
+    def up(x):  # payload -> dot operand
+        return x if native else x.astype(f32)
+
+    if quant and not var.fold_scales:
+        k_blk = k_blk.astype(f32) * ks_blk[:, :, None]
+        v_blk = v_blk.astype(f32) * vs_blk[:, :, None]
+        quant = False  # dequantized: downstream treats as dense
+    elif quant:
+        k_blk = k_blk.astype(f32)
+        v_blk = v_blk.astype(f32)
+
+    if var.head_batched:
+        q = up(q_ref[0])  # [KVH, R, D]
+        # Batched over KVH: q [KVH, R, D] x k [KB, KVH, D] -> [KVH, R, KB]
+        s = jax.lax.dot_general(
+            q, up(k_blk),
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=f32,
+        )
+        if quant:  # fold_scales: ks [KB, KVH] -> [KVH, 1, KB]
+            s = s * jnp.transpose(ks_blk)[:, None, :]
+        s = s * scale
+        s = jnp.where(valid[None, None, :] != 0, s, f32(-1e30))
+        m_prev = m_scr[...].astype(f32)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = (
+            l_scr[...].astype(f32) * corr + p.sum(axis=-1)
+        ).astype(l_scr.dtype)
+        if quant:  # fold_scales: vs [KB, KVH] -> [KVH, 1, KB]
+            p = p * jnp.transpose(vs_blk)[:, None, :]
+        # p [KVH, R, KB] x v [KB, KVH, D] -> [KVH, R, D]
+        pv = jax.lax.dot_general(
+            p, up(v_blk),
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=f32,
+        )
+        a_scr[...] = (
+            a_scr[...].astype(f32) * corr[..., None] + pv
+        ).astype(a_scr.dtype)
+        m_scr[...] = m_new.astype(m_scr.dtype)
+        return
+
+    for g in range(kvh):
+        q = up(q_ref[0, g])  # [R, D]
+        k = up(k_blk[:, g])  # [KB, D]
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=f32,
+        )  # [R, KB]
+        if quant:
+            s = s * ks_blk[None, :, g]
+        s = s * scale
+        s = jnp.where(valid[None, :] != 0, s, f32(-1e30))
+        m_prev = m_scr[g].astype(f32)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[g] = (l_scr[g].astype(f32) * corr + p.sum(axis=-1)).astype(
+            l_scr.dtype
+        )
+        if quant:
+            p = p * vs_blk[None, :, g]
+        pv = jax.lax.dot_general(
+            p, up(v_blk[:, g]),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        a_scr[g] = (a_scr[g].astype(f32) * corr[:, None] + pv).astype(
+            a_scr.dtype
+        )
+        m_scr[g] = m_new.astype(m_scr.dtype)
+
+
+def _paged_kernel_v(*refs, scale: float, kvh: int, bs: int, quant: bool,
+                    var: Variant):
+    """Grid step (b, j): fold blocks ``table[b, j*K .. j*K+K-1]`` into
+    row b's accumulators; finalize on the last step.  Ref layout:
+    tbl (prefetch), q [1, KVH, R, D], then K k-blocks [1, BS, KVH, D]
+    (+K [1, BS, KVH] k-scales when quant), K v-blocks (+K v-scales),
+    valid [1, 1, K*BS], output, then m/l/acc scratch."""
     from jax.experimental import pallas as pl
 
+    K = var.blocks_per_step
+    it = iter(refs)
+    next(it)  # tbl_ref: consumed by the index maps, not the body
+    q_ref = next(it)
+    k_refs = [next(it) for _ in range(K)]
+    ks_refs = [next(it) for _ in range(K)] if quant else [None] * K
+    v_refs = [next(it) for _ in range(K)]
+    vs_refs = [next(it) for _ in range(K)] if quant else [None] * K
+    valid_ref = next(it)
+    o_ref = next(it)
+    m_scr, l_scr, a_scr = next(it), next(it), next(it)
+
     j = pl.program_id(1)
-    nb = pl.num_programs(1)
+    nsteps = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
@@ -90,54 +278,38 @@ def _paged_body(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, valid_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         a_scr[...] = jnp.zeros_like(a_scr)
 
-    valid = valid_ref[0, 0]  # [BS]
-    ks_all = None if ks_ref is None else ks_ref[0].astype(jnp.float32)
-    vs_all = None if vs_ref is None else vs_ref[0].astype(jnp.float32)
-    for g in range(kvh):
-        q = q_ref[0, g].astype(jnp.float32)  # [R, D]
-        k = k_ref[0, :, g].astype(jnp.float32)  # [BS, D]
-        if ks_all is not None:
-            k = k * ks_all[:, g:g + 1]
-        s = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [R, BS]
-        s = jnp.where(valid[None, :] != 0, s, jnp.float32(-1e30))
-        m_prev = m_scr[g]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[g] = l_scr[g] * corr + p.sum(axis=-1)
-        v = v_ref[0, :, g].astype(jnp.float32)
-        if vs_all is not None:
-            v = v * vs_all[:, g:g + 1]
-        a_scr[g] = a_scr[g] * corr[:, None] + jax.lax.dot_general(
-            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+    if K == 1:
+        k_blk = k_refs[0][0]
+        v_blk = v_refs[0][0]
+        ks_blk = ks_refs[0][0].astype(jnp.float32) if quant else None
+        vs_blk = vs_refs[0][0].astype(jnp.float32) if quant else None
+    else:
+        k_blk = jnp.concatenate([r[0] for r in k_refs], axis=0)
+        v_blk = jnp.concatenate([r[0] for r in v_refs], axis=0)
+        ks_blk = (
+            jnp.concatenate([r[0] for r in ks_refs], axis=0).astype(
+                jnp.float32
+            ) if quant else None
         )
-        m_scr[g] = m_new
+        vs_blk = (
+            jnp.concatenate([r[0] for r in vs_refs], axis=0).astype(
+                jnp.float32
+            ) if quant else None
+        )
+    valid = valid_ref[0, 0]  # [K*BS]
+    _fold_block(q_ref, k_blk, ks_blk, v_blk, vs_blk, valid, m_scr, l_scr,
+                a_scr, scale=scale, kvh=kvh, var=var)
 
-    @pl.when(j == nb - 1)
+    @pl.when(j == nsteps - 1)
     def _finalize():
-        o_ref[0] = (
-            a_scr[...] / jnp.maximum(l_scr[...], 1e-20)[..., None]
-        ).astype(o_ref.dtype)
+        acc = a_scr[...].astype(jnp.float32)
+        l = l_scr[...].astype(jnp.float32)
+        o_ref[0] = (acc / jnp.maximum(l, 1e-20)[..., None]).astype(o_ref.dtype)
 
 
-def _paged_kernel(tbl_ref, q_ref, k_ref, v_ref, valid_ref, o_ref,
-                  m_scr, l_scr, a_scr, *, scale: float, kvh: int):
-    _paged_body(tbl_ref, q_ref, k_ref, None, v_ref, None, valid_ref,
-                o_ref, m_scr, l_scr, a_scr, scale=scale, kvh=kvh)
-
-
-def _paged_kernel_kv8(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
-                      valid_ref, o_ref, m_scr, l_scr, a_scr, *,
-                      scale: float, kvh: int):
-    _paged_body(tbl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, valid_ref,
-                o_ref, m_scr, l_scr, a_scr, scale=scale, kvh=kvh)
-
-
-@functools.partial(jax.jit, static_argnames=("block_size", "scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "scale", "interpret", "variant")
+)
 def paged_decode_attention(
     q: jax.Array,  # [B, H, D] — one query per row
     k_pool: jax.Array,  # [NB, BS, KVH, D] dense, or int8 payload
@@ -149,50 +321,85 @@ def paged_decode_attention(
     v_scale: jax.Array | None = None,
     scale: float | None = None,
     interpret: bool = False,
+    variant: str = "",
 ) -> jax.Array:
     """Fused paged decode attention; returns ``[B, H, D]``.
 
-    Grid (B, T): program (b, j) DMAs block ``table[b, j]`` of the pool
-    into VMEM via the scalar-prefetched table — HBM traffic is exactly
-    the row's live blocks, never a materialized dense gather — and
-    accumulates FlashAttention-style (the block axis is sequential on
-    TPU, so the VMEM scratch carries m/l/acc across it).  VMEM per
-    program is one [BS, KVH, D] K+V block pair + [KVH, R, D] f32
-    accumulators: ~50 KB at BS=16, KVH=4, D=64 — tiny, so pool size
-    never hits a VMEM wall (the whole-slab decode kernel's limit)."""
+    Grid (B, T/K): program (b, j) DMAs blocks ``table[b, j*K..]`` of
+    the pool into VMEM via the scalar-prefetched table — HBM traffic
+    is exactly the row's live blocks, never a materialized dense
+    gather — and accumulates FlashAttention-style (the block axis is
+    sequential on TPU, so the VMEM scratch carries m/l/acc across it).
+    ``variant`` selects a tuning point (see :class:`Variant`); K must
+    divide the table width T (``ops/autotune.py`` only enumerates
+    divisors, so serving never needs a pad-block path).  VMEM per
+    program is K [BS, KVH, D] K+V block pairs + [KVH, R, D] f32
+    accumulators — ``autotune.paged_vmem_bytes`` is the budget model.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    var = parse_variant(variant)
+    K = var.blocks_per_step
     b, h, d = q.shape
     nb_pool, bs, kvh, _ = k_pool.shape
     t = table.shape[1]
     n_rep = h // kvh
+    if t % K != 0:
+        raise ValueError(
+            f"variant {var.key()!r}: blocks_per_step={K} does not divide "
+            f"table width {t}"
+        )
+    tsteps = t // K
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    quant = k_scale is not None
+    acc_jnp = jnp.float32 if var.acc_dtype == "f32" else jnp.bfloat16
     qg = q.reshape(b, kvh, n_rep, d)
     tbl = jnp.clip(table, 0, nb_pool - 1).astype(jnp.int32)
-    validb = key_valid.astype(jnp.int32).reshape(b, t, bs)
+    validb = key_valid.astype(jnp.int32).reshape(b, tsteps, K * bs)
 
     q_spec = pl.BlockSpec((1, kvh, n_rep, d), lambda i, j, tb: (i, 0, 0, 0))
-    kv_spec = pl.BlockSpec((1, bs, kvh, d), lambda i, j, tb: (tb[i, j], 0, 0, 0))
-    valid_spec = pl.BlockSpec((1, 1, bs), lambda i, j, tb: (i, j, 0))
-    scratch = [
-        pltpu.VMEM((kvh, n_rep), jnp.float32),
-        pltpu.VMEM((kvh, n_rep), jnp.float32),
-        pltpu.VMEM((kvh, n_rep, d), jnp.float32),
+    kv_specs = [
+        pl.BlockSpec(
+            (1, bs, kvh, d),
+            functools.partial(
+                lambda i, j, tb, _m: (tb[i, j * K + _m], 0, 0, 0), _m=m
+            ),
+        )
+        for m in range(K)
     ]
-    if k_scale is None:
-        kernel = functools.partial(_paged_kernel, scale=scale, kvh=kvh)
-        in_specs = [q_spec, kv_spec, kv_spec, valid_spec]
-        args = (tbl, qg, k_pool, v_pool, validb)
+    sc_specs = [
+        pl.BlockSpec(
+            (1, bs, kvh),
+            functools.partial(
+                lambda i, j, tb, _m: (tb[i, j * K + _m], 0, 0), _m=m
+            ),
+        )
+        for m in range(K)
+    ]
+    valid_spec = pl.BlockSpec((1, 1, K * bs), lambda i, j, tb: (i, j, 0))
+    scratch = [
+        pltpu.VMEM((kvh, n_rep), acc_jnp),
+        pltpu.VMEM((kvh, n_rep), acc_jnp),
+        pltpu.VMEM((kvh, n_rep, d), acc_jnp),
+    ]
+    kernel = functools.partial(
+        _paged_kernel_v, scale=scale, kvh=kvh, bs=bs, quant=quant, var=var
+    )
+    if not quant:
+        in_specs = [q_spec, *kv_specs, *kv_specs, valid_spec]
+        args = (tbl, qg, *([k_pool] * K), *([v_pool] * K), validb)
     else:
-        sc_spec = pl.BlockSpec((1, bs, kvh), lambda i, j, tb: (tb[i, j], 0, 0))
-        kernel = functools.partial(_paged_kernel_kv8, scale=scale, kvh=kvh)
-        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec, valid_spec]
-        args = (tbl, qg, k_pool, k_scale[..., 0], v_pool, v_scale[..., 0], validb)
+        in_specs = [q_spec, *kv_specs, *sc_specs, *kv_specs, *sc_specs,
+                    valid_spec]
+        args = (
+            tbl, qg, *([k_pool] * K), *([k_scale[..., 0]] * K),
+            *([v_pool] * K), *([v_scale[..., 0]] * K), validb,
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, t),
+        grid=(b, tsteps),
         in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=scratch,

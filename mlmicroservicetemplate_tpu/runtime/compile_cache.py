@@ -321,6 +321,17 @@ def cache_stats() -> dict:
         return {"entries": len(_CACHE), **_COUNTS}
 
 
+def cache_kinds() -> dict:
+    """Entry count per ``kind`` — lets /status and the autotuner tests
+    see e.g. how many ``paged_decode_kernel`` variants are installed
+    without exposing the raw keys (which embed bundle fingerprints)."""
+    with _LOCK:
+        out: dict = {}
+        for key in _CACHE:
+            out[key[1]] = out.get(key[1], 0) + 1
+        return out
+
+
 def clear() -> None:
     """Test hook: drop every cached wrapper and zero the event counts
     (compile totals are process-lifetime and stay)."""

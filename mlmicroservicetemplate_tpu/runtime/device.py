@@ -65,6 +65,22 @@ def enable_compilation_cache(device: str,
     return cache_dir
 
 
+def tune_table_default(cache_dir: str | None) -> str | None:
+    """Default location for the Pallas kernel tuning table
+    (ops/autotune.py): alongside the persistent XLA disk cache when the
+    operator configured one, so the tuned-variant choices and the
+    executables they select survive restarts TOGETHER — a table entry
+    whose executable is also disk-cached costs a restart zero compiles
+    (docs/kernel_tuning.md).  No cache dir -> no persistence (None):
+    the sweep re-runs per process, which is the correct default for
+    tests and CPU golden runs that want cold, hermetic state.
+    """
+    if not cache_dir or cache_dir.strip().lower() in (
+            "", "0", "false", "no", "off"):
+        return None
+    return os.path.join(cache_dir, "pallas_tune.json")
+
+
 def apply_device_env(device: str, compile_cache_dir: str | None = None
                      ) -> None:
     """Map DEVICE=tpu|cpu onto JAX_PLATFORMS before jax is imported.

@@ -221,6 +221,32 @@ class ServiceConfig(BaseModel):
     # Tokens per KV block in paged mode.  Must divide every seq bucket
     # (prefix sharing relies on bucket-aligned block boundaries).
     kv_block_size: int = 16
+    # -- Pallas decode-kernel selection (docs/kernel_tuning.md) --------
+    # Measured kernel-variant sweep at warmup (ops/autotune.py): every
+    # feasible variant is verified against the jnp reference and timed
+    # at the real serving shapes; the winner installs into the shared
+    # ExecutableCache and persists in the tuning table, so replica
+    # spawns/rebuilds inherit it with zero extra compiles.  Off =
+    # default kernel everywhere (the seed behavior).
+    pallas_autotune: bool = False
+    # Pin one kernel variant fleet-wide (Variant grammar, e.g.
+    # "b4-hb"); validated at boot.  None = autotuned-or-default.
+    pallas_variant: str | None = None
+    # Run Pallas kernels in interpret mode and lift the TPU backend
+    # gate — CPU CI and the pallas_ab bench exercise the real kernel
+    # path; never set this on a TPU deployment.
+    pallas_interpret: bool = False
+    # Contiguous-slab Pallas attention cutover: prompts at or under
+    # this length run the single-block fused kernel (ops/attention.
+    # use_pallas_attention); longer prompts take the XLA path.  Env is
+    # read by ops/attention directly (config-less callers: benchmarks,
+    # unit tests); this field validates it at boot.
+    pallas_single_block_max_seq: int = 512
+    # VMEM budget (MB) the decode-kernel fit gate AND the autotuner's
+    # variant cost model filter against (ops/attention.
+    # decode_kernel_fits, ops/autotune.paged_vmem_bytes).  ~16 MB/core
+    # physical on v4/v5e; default leaves headroom for double-buffering.
+    decode_kernel_vmem_budget_mb: int = 10
     # Host-RAM KV tier (docs/kv-tiering.md; requires PAGED_KV=1): MB of
     # host memory backing swapped-out KV.  Checkpointed streams
     # (preemption, dry-pool reclaim, supervised crash recovery, fleet
@@ -648,6 +674,34 @@ class ServiceConfig(BaseModel):
             raise ValueError("KV_BLOCK_SIZE must be in [1, 1024]")
         return v
 
+    @field_validator("pallas_variant")
+    @classmethod
+    def _check_pallas_variant(cls, v: str | None) -> str | None:
+        if v:
+            from ..ops.paged_attention import parse_variant
+
+            parse_variant(v)  # ValueError with the grammar on junk
+        return v
+
+    @field_validator("pallas_single_block_max_seq")
+    @classmethod
+    def _check_pallas_single_block(cls, v: int) -> int:
+        if not (64 <= v <= 8192):
+            raise ValueError(
+                "PALLAS_SINGLE_BLOCK_MAX_SEQ must be in [64, 8192] "
+                "(whole-slab kernel: one grid block per sequence)"
+            )
+        return v
+
+    @field_validator("decode_kernel_vmem_budget_mb")
+    @classmethod
+    def _check_decode_vmem_budget(cls, v: int) -> int:
+        if not (1 <= v <= 256):
+            raise ValueError(
+                "DECODE_KERNEL_VMEM_BUDGET_MB must be in [1, 256] MB"
+            )
+        return v
+
     @field_validator("prefill_chunk", "prefill_budget", "prefill_max_prompt")
     @classmethod
     def _check_prefill(cls, v: int) -> int:
@@ -945,6 +999,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "compile_cache_dir": "COMPILE_CACHE_DIR",
         "latency_buckets": "LATENCY_BUCKETS",
         "slo_windows_s": "SLO_WINDOWS_S",
+        "pallas_variant": "PALLAS_VARIANT",
     }
     for field, var in mapping.items():
         v = get(var)
@@ -982,6 +1037,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "fleet_breaker_n": "FLEET_BREAKER_N",
         "trace_ring": "TRACE_RING",
         "flight_ring": "FLIGHT_RING",
+        "pallas_single_block_max_seq": "PALLAS_SINGLE_BLOCK_MAX_SEQ",
+        "decode_kernel_vmem_budget_mb": "DECODE_KERNEL_VMEM_BUDGET_MB",
     }
     for field, var in int_mapping.items():
         v = get(var)
@@ -1037,6 +1094,12 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
     v = get("PAGED_KV")
     if v is not None:
         kwargs["paged_kv"] = v.lower() not in ("0", "false", "no")
+    v = get("PALLAS_AUTOTUNE")
+    if v is not None:
+        kwargs["pallas_autotune"] = v.lower() not in ("0", "false", "no")
+    v = get("PALLAS_INTERPRET")
+    if v is not None:
+        kwargs["pallas_interpret"] = v.lower() not in ("0", "false", "no")
     v = get("JOBS_ENABLED")
     if v is not None:
         kwargs["jobs_enabled"] = v.lower() not in ("0", "false", "no")

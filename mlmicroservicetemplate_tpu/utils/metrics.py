@@ -390,6 +390,16 @@ EXEC_CACHE_EVENTS = Counter(
     "was cached) — runtime/compile_cache.py, docs/compilation.md",
     ["event"],
 )
+PALLAS_AUTOTUNE_EVENTS = Counter(
+    "pallas_autotune_events_total",
+    "Decode-kernel autotuner decisions by event (sweep = a measured "
+    "variant search ran; hit = the tuning table answered without one; "
+    "pin = PALLAS_VARIANT honored; install = a winner entered the "
+    "ExecutableCache; reject_vmem/reject_verify/reject_error = "
+    "candidates dropped by the cost model / reference check / build "
+    "failure) — ops/autotune.py, docs/kernel_tuning.md",
+    ["event"],
+)
 TBT = Histogram(
     "stream_tbt_seconds",
     "Streaming inter-chunk delivery gap (time between consecutive "

@@ -15,7 +15,13 @@ committed ``benchmarks/perf_baseline.json``:
   the count can only legitimately go DOWN);
 - ``swap_fallbacks`` / ``perf_pending_dispatches``: exact 0 (a leaked
   pending submit means a fetch seam stopped sampling);
-- ``prep_staged``: floor (the double-buffer must keep staging).
+- ``prep_staged``: floor (the double-buffer must keep staging);
+- ``autotune_variants_swept`` / ``autotune_installs``: exact (r21 —
+  the workload runs with PALLAS_AUTOTUNE on and the interpret-mode
+  kernel path enabled; the measured sweep must enumerate the same
+  candidate set and install exactly one winner, and the serve-time
+  compile pin above proves the tuned executable came out of the
+  warm-time ExecutableCache install, not a request-path trace).
 
 Wall-clock appears nowhere — the gate is CPU-noise-immune by
 construction.  ``PERF_SMOKE_UPDATE=1`` rewrites the baseline (do this
@@ -52,6 +58,8 @@ RULES = {
     "perf_pending_dispatches": ("eq", 0.0),
     "host_syncs_per_token": ("le", 0.10),
     "prep_staged": ("ge", 0.34),
+    "autotune_variants_swept": ("eq", 0.0),
+    "autotune_installs": ("eq", 0.0),
 }
 
 
@@ -62,6 +70,7 @@ def run_workload() -> dict:
     from mlmicroservicetemplate_tpu.engine import InferenceEngine
     from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
     from mlmicroservicetemplate_tpu.parallel import ReplicaSet, make_mesh
+    from mlmicroservicetemplate_tpu.ops import autotune
     from mlmicroservicetemplate_tpu.runtime.compile_cache import CompileWindow
     from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
     from perf_ledger import append_row, structural_counters
@@ -70,8 +79,13 @@ def run_workload() -> dict:
         device="cpu", warmup=False, batch_buckets=(1, 2),
         seq_buckets=(8, 16), max_decode_len=16, stream_chunk_tokens=4,
         max_streams=2, stream_pipeline=1, paged_kv=True, kv_block_size=4,
+        # r21: the autotuner sweep runs at warm time (interpret-mode
+        # kernels — this gate runs on CPU) so its structural counters
+        # are pinned alongside the dispatch arithmetic.
+        pallas_autotune=True, pallas_interpret=True,
     )
-    bundle = tiny_gpt_bundle()
+    autotune.clear()
+    bundle = tiny_gpt_bundle(pallas_decode=True, pallas_interpret=True)
     engine = InferenceEngine(bundle, cfg, ReplicaSet(make_mesh(1)))
     cdl = ContinuousDecodeLoop(engine, cfg)
     cdl.warm()

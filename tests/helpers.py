@@ -148,15 +148,17 @@ TINY_LLAMA = dict(
 )
 
 
-def tiny_gpt_bundle(seed: int = 0) -> ModelBundle:
+def tiny_gpt_bundle(seed: int = 0, **cfg_overrides) -> ModelBundle:
     """Tiny decoder-only bundle with the full fn surface the engine
-    serves (contiguous chunk + paged chunk), for loop/scheduler tests."""
+    serves (contiguous chunk + paged chunk), for loop/scheduler tests.
+    ``cfg_overrides`` land on GPTConfig (e.g. ``pallas_decode=True,
+    pallas_interpret=True`` for the autotuner smokes)."""
     import jax
 
     from mlmicroservicetemplate_tpu.models import gpt as gpt_mod
     from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
 
-    cfg = gpt_mod.GPTConfig(**TINY_GPT)
+    cfg = gpt_mod.GPTConfig(**{**TINY_GPT, **cfg_overrides})
     params = gpt_mod.init_params(jax.random.PRNGKey(seed), cfg)
     return ModelBundle(
         name="gpt2", kind=KIND_SEQ2SEQ, cfg=cfg, params=params,
@@ -195,13 +197,16 @@ def tiny_gpt_bundle(seed: int = 0) -> ModelBundle:
     )
 
 
-def tiny_llama_bundle(seed: int = 0, kv_quant: bool = False) -> ModelBundle:
+def tiny_llama_bundle(seed: int = 0, kv_quant: bool = False,
+                      **cfg_overrides) -> ModelBundle:
     import jax
 
     from mlmicroservicetemplate_tpu.models import llama as llama_mod
     from mlmicroservicetemplate_tpu.models.tokenizer import ByteTokenizer
 
-    cfg = llama_mod.LlamaConfig(**TINY_LLAMA, kv_quant=kv_quant)
+    cfg = llama_mod.LlamaConfig(
+        **{**TINY_LLAMA, "kv_quant": kv_quant, **cfg_overrides}
+    )
     params = llama_mod.init_params(jax.random.PRNGKey(seed), cfg)
     return ModelBundle(
         name="llama", kind=KIND_SEQ2SEQ, cfg=cfg, params=params,

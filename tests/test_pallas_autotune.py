@@ -1,0 +1,476 @@
+"""Pallas decode-kernel autotuner (ISSUE 16, r21).
+
+Five layers:
+
+1. **Variant identity**: every variant the sweep can enumerate —
+   block folds × head-batching × int8 scale folding — is
+   token-identical to ``paged_attention_ref`` in interpret mode,
+   including the edge shapes the grammar must survive: all-invalid
+   sentinel tables, kvh=1, GQA n_rep>1, a part-filled tail block.
+2. **Grammar + cost model units**: parse/validation errors surface at
+   boot (bad pin, non-divisor fold), ``enumerate_variants`` prunes
+   no-op axes and counts VMEM rejections, ``paged_vmem_bytes`` moves
+   in the directions the axes promise.
+3. **Autotuner flows**: sweep → winner installed in the
+   ExecutableCache + counters move; second call is a table *hit* (no
+   re-sweep); a JSON table round-trips a process restart; a pin skips
+   the sweep; a pinned warm pays zero serve-time compiles.
+4. **graftlint exec-cache rule**: positive / waived / clean fixtures
+   for the new rule keeping serving-layer jits on the cache route.
+5. **bench weather probe** (r05 regression): ``sanity_check_weather``
+   rejects the impossible 0.0 probe unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mlmicroservicetemplate_tpu.ops import autotune
+from mlmicroservicetemplate_tpu.ops.attention import decode_attention
+from mlmicroservicetemplate_tpu.ops.paged_attention import (
+    Variant,
+    paged_attention_ref,
+    paged_decode_attention,
+    parse_variant,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_autotuner():
+    autotune.clear()
+    yield
+    autotune.clear()
+
+
+def _paged_problem(b=2, kvh=2, n_rep=2, d=8, bs=4, t=4, quant=False,
+                   seed=0, all_invalid=False, tail=True):
+    """Deterministic paged decode problem + its jnp reference."""
+    rng = np.random.default_rng(seed)
+    h = kvh * n_rep
+    nb_pool = t + 2
+    q = jnp.asarray(rng.normal(size=(b, h, d)).astype(np.float32))
+    kf = rng.normal(size=(nb_pool, bs, kvh, d)).astype(np.float32)
+    vf = rng.normal(size=(nb_pool, bs, kvh, d)).astype(np.float32)
+    table = np.stack(
+        [rng.permutation(nb_pool)[:t] for _ in range(b)]
+    ).astype(np.int32)
+    valid = np.ones((b, t * bs), np.int32)
+    if tail:
+        valid[:, -max(bs // 2, 1):] = 0
+    if all_invalid:
+        table[0] = -1  # sentinel: no block mapped for this row at all
+        valid[0] = 0
+    ks = vs = None
+    if quant:
+        ksf = np.abs(kf).max(axis=3, keepdims=True) / 127.0 + 1e-6
+        vsf = np.abs(vf).max(axis=3, keepdims=True) / 127.0 + 1e-6
+        kf = np.clip(np.round(kf / ksf), -127, 127).astype(np.int8)
+        vf = np.clip(np.round(vf / vsf), -127, 127).astype(np.int8)
+        ks = jnp.asarray(ksf.astype(np.float32))
+        vs = jnp.asarray(vsf.astype(np.float32))
+    args = (q, jnp.asarray(kf), jnp.asarray(vf), jnp.asarray(table),
+            jnp.asarray(valid))
+    ref = paged_attention_ref(*args, bs, k_scale=ks, v_scale=vs)
+    return args, ks, vs, ref
+
+
+# ---------------------------------------------------------------------------
+# 1. every enumerable variant is token-identical to the reference
+
+
+def _enumerable_keys(t, quant):
+    keys = []
+    for k in autotune.BLOCK_FOLDS:
+        if t % k != 0 or k > t:
+            continue
+        for hb in ("", "-hb"):
+            for fs in (("", "-fs") if quant else ("",)):
+                keys.append(f"b{k}{hb}{fs}")
+    return keys
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_every_variant_matches_reference(quant):
+    args, ks, vs, ref = _paged_problem(t=4, quant=quant)
+    for vkey in _enumerable_keys(4, quant):
+        got = paged_decode_attention(
+            *args, 4, k_scale=ks, v_scale=vs, interpret=True, variant=vkey
+        )
+        # fs reassociates the scale multiply (same products, different
+        # order) — rtol, not bit-equality, is the honest pin there.
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-6, atol=2e-5,
+            err_msg=f"variant {vkey!r} diverged from reference",
+        )
+
+
+def test_default_variant_is_bit_identical_to_empty_key():
+    """"" and "b1" are the same (pre-autotuner) kernel, bitwise."""
+    args, ks, vs, _ = _paged_problem()
+    base = paged_decode_attention(*args, 4, interpret=True, variant="")
+    b1 = paged_decode_attention(*args, 4, interpret=True, variant="b1")
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(b1))
+
+
+@pytest.mark.parametrize("vkey", ["b1", "b2-hb", "b4"])
+def test_all_invalid_row_stays_finite(vkey):
+    """A stream whose whole table is the -1 sentinel (admitted but not
+    yet prefilled) must produce finite output — the no-pad-block design
+    exists exactly so folded variants cannot read a phantom block."""
+    args, ks, vs, ref = _paged_problem(all_invalid=True)
+    got = paged_decode_attention(*args, 4, interpret=True, variant=vkey)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-6, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("kvh,n_rep", [(1, 4), (2, 1), (2, 4)])
+def test_variant_identity_across_head_layouts(kvh, n_rep):
+    """kvh=1 (max GQA), n_rep=1 (MHA — the gpt corner) and a wide GQA
+    repeat all hold across the fold/head-batch grammar."""
+    args, ks, vs, ref = _paged_problem(kvh=kvh, n_rep=n_rep, seed=3)
+    for vkey in ("b1", "b2", "b4-hb"):
+        got = paged_decode_attention(*args, 4, interpret=True, variant=vkey)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-6, atol=2e-5,
+            err_msg=f"kvh={kvh} n_rep={n_rep} variant={vkey}",
+        )
+
+
+def test_slab_decode_variants_match_reference():
+    b, t, kvh, n_rep, d = 2, 16, 2, 2, 8
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(b, kvh * n_rep, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, t, kvh, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, t, kvh, d)).astype(np.float32))
+    mask = np.ones((b, t), np.int32)
+    mask[:, -3:] = 0
+    mask = jnp.asarray(mask)
+    ref = decode_attention(q, k, v, mask, interpret=True, variant="")
+    got = decode_attention(q, k, v, mask, interpret=True, variant="b1-hb")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-6, atol=2e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# 2. grammar + cost model
+
+
+def test_parse_variant_grammar():
+    assert parse_variant("") == Variant(1, False, False, False)
+    assert parse_variant("b1") == Variant(1, False, False, False)
+    v = parse_variant("b4-hb-fs")
+    assert (v.blocks_per_step, v.head_batched, v.fold_scales) == (4, True, True)
+    assert parse_variant("b2-accbf16").acc_dtype == "bf16"
+    with pytest.raises(ValueError):
+        parse_variant("b0")
+    with pytest.raises(ValueError):
+        parse_variant("b2-warp")  # unknown axis token
+
+
+def test_nondivisor_fold_rejected_at_call():
+    args, *_ = _paged_problem(t=4)
+    with pytest.raises(ValueError, match="divide"):
+        paged_decode_attention(*args, 4, interpret=True, variant="b3")
+
+
+def test_pin_validated_at_ensure_tuned():
+    with pytest.raises(ValueError, match="does not divide"):
+        autotune.ensure_tuned(
+            "paged_decode", None, None, b=1, kvh=1, n_rep=1, d=8,
+            block_size=4, t=4, interpret=True, pin="b3", table_path=None,
+        )
+    with pytest.raises(ValueError):
+        autotune.ensure_tuned(
+            "paged_decode", None, None, b=1, kvh=1, n_rep=1, d=8,
+            block_size=4, t=4, interpret=True, pin="junk", table_path=None,
+        )
+
+
+def test_enumerate_prunes_noop_axes():
+    # f32 dense: no nat, no fs; folds are divisors of t only.
+    vs = autotune.enumerate_variants(
+        "paged_decode", t=6, bs=4, kvh=2, d=8, n_rep=2,
+        dtype="float32", quant=False, budget=1 << 30,
+    )
+    keys = {v.key() for v in vs}
+    assert keys == {"b1", "b1-hb", "b2", "b2-hb"}  # 4,8 don't divide 6
+    # int8: fs doubles the set; nat still absent (quantized payloads).
+    vq = autotune.enumerate_variants(
+        "paged_decode", t=2, bs=4, kvh=2, d=8, n_rep=2,
+        dtype="bfloat16", quant=True, budget=1 << 30,
+    )
+    kq = {v.key() for v in vq}
+    assert kq == {"b1", "b1-fs", "b1-hb", "b1-hb-fs",
+                  "b2", "b2-fs", "b2-hb", "b2-hb-fs"}
+    # bf16 dense: nat appears, fs doesn't.
+    vb = autotune.enumerate_variants(
+        "slab_decode", t=8, bs=0, kvh=2, d=8, n_rep=2,
+        dtype="bfloat16", quant=False, budget=1 << 30,
+    )
+    assert {v.key() for v in vb} == {"b1", "b1-hb", "b1-nat", "b1-hb-nat"}
+    # accbf16 is never enumerated anywhere.
+    assert not any("accbf16" in v.key() for v in vs + vq + vb)
+
+
+def test_vmem_model_directions():
+    base = dict(bs=16, kvh=4, d=64, n_rep=2, payload_bytes=2, quant=False)
+    b1 = autotune.paged_vmem_bytes(Variant(1, False, False, False), **base)
+    b4 = autotune.paged_vmem_bytes(Variant(4, False, False, False), **base)
+    assert b4 > b1  # more blocks per step = more VMEM
+    nat = autotune.paged_vmem_bytes(Variant(1, False, True, False), **base)
+    assert nat < b1  # native width skips the f32 upcast copies
+    acc = autotune.paged_vmem_bytes(
+        Variant(1, False, False, False, acc_dtype="bf16"), **base
+    )
+    assert acc < b1  # halved scratch
+
+
+def test_enumerate_counts_vmem_rejections():
+    before = autotune.stats()["counts"]["reject_vmem"]
+    vs = autotune.enumerate_variants(
+        "paged_decode", t=8, bs=16, kvh=4, d=64, n_rep=2,
+        dtype="float32", quant=False, budget=100_000,  # tiny budget
+    )
+    after = autotune.stats()["counts"]["reject_vmem"]
+    assert after > before
+    assert all(
+        autotune.paged_vmem_bytes(
+            v, bs=16, kvh=4, d=64, n_rep=2, payload_bytes=4, quant=False
+        ) <= 100_000
+        for v in vs
+    )
+
+
+def test_tune_key_is_shape_only():
+    """The key has no model/replica component — two bundles with the
+    same decode shape share one tuning entry (the λScale property)."""
+    k = autotune.tune_key("paged_decode", b=2, kvh=2, n_rep=2, d=8,
+                          block_size=4, t=4, dtype="float32", quant=False)
+    assert k == "paged_decode/B2-G2-R2-D8-bs4-T4-float32"
+    kq = autotune.tune_key("paged_decode", b=2, kvh=2, n_rep=2, d=8,
+                           block_size=4, t=4, dtype="float32", quant=True)
+    assert kq.endswith("-q8") and kq != k
+
+
+# ---------------------------------------------------------------------------
+# 3. autotuner flows
+
+
+class _Bundle:
+    name = "autotune-test"
+
+
+_SHAPE = dict(b=2, kvh=2, n_rep=2, d=8, block_size=4, t=4)
+
+
+def test_sweep_then_hit_then_lookup():
+    winner = autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, table_path=None,
+    )
+    c = autotune.stats()["counts"]
+    assert c["sweeps"] == 1 and c["installs"] == 1 and c["hits"] == 0
+    assert c["timed"] == c["candidates"] > 1  # all candidates verified
+    assert c["reject_verify"] == 0 and c["reject_error"] == 0
+    # the winner is a legal enumerable variant for this shape
+    assert parse_variant(winner).blocks_per_step in (1, 2, 4)
+    # second call: table hit, no second sweep
+    again = autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, table_path=None,
+    )
+    c = autotune.stats()["counts"]
+    assert again == winner and c["sweeps"] == 1 and c["hits"] == 1
+    # trace-time resolution sees the same winner; unknown shape -> ""
+    assert autotune.lookup(
+        "paged_decode", **_SHAPE, dtype="float32", quant=False
+    ) == winner
+    assert autotune.lookup(
+        "paged_decode", **{**_SHAPE, "t": 8}, dtype="float32", quant=False
+    ) == ""
+
+
+def test_winner_installed_in_executable_cache():
+    from mlmicroservicetemplate_tpu.runtime import compile_cache as cc
+
+    cc.clear()
+    bundle = _Bundle()  # one bundle object, like one serving process
+    try:
+        autotune.ensure_tuned(
+            "paged_decode", bundle, None, **_SHAPE,
+            interpret=True, table_path=None,
+        )
+        assert cc.cache_kinds().get("paged_decode_kernel") == 1
+        # the same key re-resolved does NOT mint a second entry
+        autotune.ensure_tuned(
+            "paged_decode", bundle, None, **_SHAPE,
+            interpret=True, table_path=None,
+        )
+        assert cc.cache_kinds().get("paged_decode_kernel") == 1
+    finally:
+        cc.clear()
+
+
+def test_table_persists_across_restart(tmp_path):
+    path = str(tmp_path / "tune.json")
+    winner = autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, table_path=path,
+    )
+    data = json.load(open(path))
+    assert list(data["table"].values()) == [winner]
+    # "restart": fresh process state, same table file -> hit, no sweep
+    autotune.clear()
+    again = autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, table_path=path,
+    )
+    c = autotune.stats()["counts"]
+    assert again == winner and c["sweeps"] == 0 and c["hits"] == 1
+
+
+def test_corrupt_table_is_nonfatal(tmp_path):
+    path = str(tmp_path / "tune.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    winner = autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, table_path=path,
+    )
+    c = autotune.stats()["counts"]
+    assert winner and c["persist_errors"] >= 1 and c["sweeps"] == 1
+    # the sweep's rewrite leaves a valid table behind
+    assert json.load(open(path))
+
+
+def test_pin_skips_sweep_and_zero_serve_compiles():
+    from mlmicroservicetemplate_tpu.runtime.compile_cache import CompileWindow
+
+    vkey = autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, pin="b2-hb", table_path=None,
+    )
+    c = autotune.stats()["counts"]
+    assert vkey == "b2-hb" and c["pins"] == 1 and c["sweeps"] == 0
+    # warm the installed executable once, then serving-shaped calls
+    # must not compile: the r19 invariant extended to tuned kernels.
+    args, ks, vs, ref = _paged_problem()
+    from mlmicroservicetemplate_tpu.runtime.compile_cache import (
+        shared_executable,
+    )
+
+    key = autotune.tune_key("paged_decode", **_SHAPE,
+                            dtype="float32", quant=False)
+    import jax
+
+    fn = shared_executable(
+        "paged_decode_kernel", _Bundle(), None,
+        lambda: jax.jit(lambda *a: paged_decode_attention(
+            *a, 4, interpret=True, variant=vkey)),
+        statics=(key, vkey),
+    )
+    out = fn(*args)  # warm trace
+    with CompileWindow() as w:
+        out2 = fn(*args)
+    assert w.compiles == 0
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-6, atol=2e-5
+    )
+
+
+def test_sweep_records_timings_for_ab():
+    """benchmarks/pallas_ab.py reads per-variant µs out of stats() —
+    the sweep must journal them."""
+    autotune.ensure_tuned(
+        "paged_decode", _Bundle(), None, **_SHAPE,
+        interpret=True, table_path=None,
+    )
+    key = autotune.tune_key("paged_decode", **_SHAPE,
+                            dtype="float32", quant=False)
+    sweep = autotune.stats()["sweeps"][key]
+    per = sweep["per_call_us"]
+    assert sweep["winner"] in per and "b1" in per
+    assert all(us > 0 for us in per.values())
+
+
+# ---------------------------------------------------------------------------
+# 4. graftlint exec-cache rule
+
+
+def _lint(src: str, rel: str = "mlmicroservicetemplate_tpu/engine/x.py"):
+    from tools.graftlint import lint_source
+
+    return lint_source(textwrap.dedent(src), rel, "exec-cache")
+
+
+def _unwaived(fs):
+    return [f for f in fs if not f.waived]
+
+
+def test_exec_cache_positive_hit():
+    fs = _lint("""
+        import jax
+
+        def warm_thing(self):
+            self._fn = jax.jit(lambda x: x + 1)
+    """)
+    assert len(_unwaived(fs)) == 1
+
+
+def test_exec_cache_builder_lambda_clean():
+    fs = _lint("""
+        import jax
+
+        def warm_thing(self):
+            self._fn = self._shared_jit(
+                "chunk", lambda: jax.jit(step), statics=(self.kernel_variant,)
+            )
+            other = shared_executable("k", b, r, lambda: jax.jit(f))
+    """)
+    assert _unwaived(fs) == []
+
+
+def test_exec_cache_waiver_and_scope():
+    fs = _lint("""
+        import jax
+
+        def probe(self):
+            # graftlint: uncached-jit(one-shot boot probe, never re-traced)
+            return jax.jit(lambda x: x)(1)
+    """)
+    assert _unwaived(fs) == []
+    # out of scope: ops/ and models/ build kernels freely
+    fs = _lint(
+        "import jax\nf = jax.jit(lambda x: x)\n",
+        rel="mlmicroservicetemplate_tpu/ops/y.py",
+    )
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# 5. bench relay-weather probe (r05 regression)
+
+
+def test_weather_zero_probe_rejected():
+    import bench
+
+    out = bench.sanity_check_weather({"relay_rtt_ms": 0.0}, {})
+    assert out == {"relay_probe_rejected": True}
+    # sub-ms against a slow measured wire: also rejected
+    out = bench.sanity_check_weather(
+        {"relay_rtt_ms": 0.4}, {"rtt_ms": 114.8}
+    )
+    assert out == {"relay_probe_rejected": True}
+    # a plausible probe passes through untouched
+    w = {"relay_rtt_ms": 1.8}
+    assert bench.sanity_check_weather(w, {"rtt_ms": 114.8}) is w

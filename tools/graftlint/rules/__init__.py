@@ -6,6 +6,7 @@ from .clock_injection import ClockInjectionRule
 from .knob_drift import KnobDriftRule
 from .metric_drift import MetricDriftRule
 from .exceptions import ExceptionDisciplineRule
+from .exec_cache import ExecCacheRule
 
 ALL_RULES = [
     DispatchGuardRule,
@@ -14,4 +15,5 @@ ALL_RULES = [
     KnobDriftRule,
     MetricDriftRule,
     ExceptionDisciplineRule,
+    ExecCacheRule,
 ]

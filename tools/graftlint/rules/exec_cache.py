@@ -1,0 +1,77 @@
+"""exec-cache coverage: serving-layer executables ride the shared cache.
+
+The r19 zero-compile-spawn invariant and the r21 kernel autotuner both
+hang off one property: every jitted executable the serving layers
+construct is keyed in the process-level ExecutableCache
+(``runtime/compile_cache.py``) — via the engine's ``_shared_jit`` or
+``shared_executable`` directly — so replica spawns, supervised
+rebuilds and journal replays resolve the SAME wrapper (and its jit
+cache) instead of re-tracing, and the compile-counting tests
+(``CompileWindow``) actually see every compile the layer can cause.
+
+A bare ``jax.jit(...)`` (or a raw ``pl.pallas_call(...)`` kernel
+construction) in ``engine/`` or ``scheduler/`` is invisible to all of
+that: it re-traces per engine object, breaks the spawn invariant
+silently, and — for kernels — bypasses the autotuner's variant keying
+(``docs/kernel_tuning.md``).  This rule flags any such call unless it
+sits inside an argument to ``_shared_jit`` / ``shared_executable``
+(the builder-lambda idiom: ``self._shared_jit("kind", lambda:
+jax.jit(fn), statics=(...))``), or carries an explicit waiver::
+
+    # graftlint: uncached-jit(<why this executable may bypass the cache>)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Finding, callee_name
+
+_SCOPES = (
+    "mlmicroservicetemplate_tpu/engine/",
+    "mlmicroservicetemplate_tpu/scheduler/",
+)
+# The cache machinery itself wraps bare jits by definition.
+_CACHE_ROUTES = {"_shared_jit", "shared_executable"}
+_FLAGGED = {"jit", "pallas_call"}
+
+
+class ExecCacheRule:
+    id = "exec-cache"
+    waiver = "uncached-jit"
+    doc = ("jax.jit / pallas_call in engine//scheduler/ must be built "
+           "through _shared_jit/shared_executable — a bare wrapper "
+           "re-traces per engine, breaks the zero-compile spawn "
+           "invariant and bypasses the autotuner's variant keying")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_SCOPES)
+
+    def check(self, ctx: Context) -> list[Finding]:
+        routed_ids: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if callee_name(node) not in _CACHE_ROUTES:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    routed_ids.add(id(sub))
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node)
+            if name not in _FLAGGED:
+                continue
+            if id(node) in routed_ids:
+                continue
+            findings.append(Finding(
+                self.id, ctx.rel, node.lineno,
+                f"`{name}(...)` built outside the ExecutableCache route "
+                f"— wrap it in _shared_jit/shared_executable so spawns "
+                f"share it and CompileWindow sees it, or waive: "
+                f"# graftlint: uncached-jit(reason)",
+                end_line=getattr(node, "end_lineno", node.lineno),
+            ))
+        return findings
