@@ -241,6 +241,15 @@ async def main() -> None:
             check=False,
         )
 
+    # Tenant fairness (round-22 tentpole): light-tenant TTFT p99 under
+    # a heavy-tenant backlog, weighted fair-share dequeue (TENANTS set)
+    # vs the plain class-weighted EDF queue.  TENANT_AB=0 skips.
+    if os.environ.get("TENANT_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "tenant_fairness_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

@@ -1,0 +1,163 @@
+"""Tenant fairness A/B: weighted fair-share dequeue vs the plain
+class-weighted EDF queue under a noisy-neighbor load shape.
+
+Two arms, each its own service boot (gpt2 streaming causal-LM through
+the continuous-batching loop, 2 slots, deep wait queue):
+
+- **edf**: ``TENANTS`` unset — the seed's behavior.  Requests still
+  carry ``X-Api-Key`` headers, but nothing classifies them: the heavy
+  tenant's backlog and the light tenants' sparse arrivals share one
+  FIFO-within-class EDF queue, so every light request waits behind the
+  entire backlog ahead of it.
+- **fair**: ``TENANTS=heavy,light1,light2,light3`` (equal weights) —
+  the SFQ virtual-time dequeue round-robins across tenants with queued
+  work, so a light arrival waits behind at most a few in-flight heavy
+  streams, not the whole backlog.
+
+Load shape per repeat: the heavy tenant dumps ``TENANT_AB_HEAVY``
+streams at once (a queue-deep backlog), then each light tenant sends
+``TENANT_AB_LIGHT`` spaced requests while the backlog drains.
+
+Reported per arm: light-tenant TTFT p50/p99, heavy-tenant TTFT p99,
+completions per class, sheds, makespan.  The judged claim (ISSUE 17):
+with the heavy backlog saturating the queue, light-tenant p99 TTFT
+under ``fair`` improves on ``edf`` — the cost being heavy-tenant TTFT,
+NOT total throughput (the slot pool never idles in either arm).
+
+    python benchmarks/tenant_fairness_ab.py               # current backend
+    DEVICE=cpu python benchmarks/tenant_fairness_ab.py    # CPU sanity run
+
+One JSON line per row to stdout, a markdown table to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+from harness import ServiceUnderTest, pctile  # noqa: E402
+
+PROMPT = "the quick brown fox jumps over the lazy dog and"
+N_HEAVY = int(os.environ.get("TENANT_AB_HEAVY", "8"))
+N_LIGHT = int(os.environ.get("TENANT_AB_LIGHT", "2"))  # per light tenant
+REPEATS = int(os.environ.get("TENANT_AB_REPEATS", "1"))
+LIGHTS = ("light1", "light2", "light3")
+
+
+async def _one(client, tenant: str):
+    """One streamed request; returns (tenant, status, ttft_s, wall_s)."""
+    t0 = time.perf_counter()
+    try:
+        resp = await client.post(
+            "/predict", json={"text": PROMPT, "stream": True},
+            headers={"X-Api-Key": tenant},
+        )
+        if resp.status != 200:
+            await resp.read()
+            return tenant, resp.status, None, None
+        ttft = None
+        async for line in resp.content:
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            if json.loads(line).get("done"):
+                break
+        return tenant, 200, ttft, time.perf_counter() - t0
+    except Exception:
+        return tenant, -1, None, None
+
+
+async def _run_load(s) -> list:
+    """One repeat: the heavy backlog lands first, then spaced light
+    arrivals ride on top while it drains."""
+    tasks = [
+        asyncio.create_task(_one(s.client, "heavy")) for _ in range(N_HEAVY)
+    ]
+    # Let the backlog reach the wait queue before the first light
+    # arrival — the contrast under test is light-behind-backlog.
+    await asyncio.sleep(0.2)
+    for _ in range(N_LIGHT):
+        for t in LIGHTS:
+            tasks.append(asyncio.create_task(_one(s.client, t)))
+        await asyncio.sleep(0.3)
+    return await asyncio.gather(*tasks)
+
+
+async def run_arm(arm: str, dev: dict) -> dict:
+    overrides = {
+        "MODEL_NAME": "gpt2",
+        "BATCH_BUCKETS": "1,2",
+        "SEQ_BUCKETS": "64",
+        "MAX_DECODE_LEN": "8",
+        # Narrow slot pool + a queue deep enough to hold the whole
+        # backlog: waiting happens in the SCHEDULABLE queue, where
+        # fair-share dequeue can reorder it — not in slots.
+        "MAX_STREAMS": "2",
+        "MAX_STREAM_QUEUE": "48",
+        **dev,
+    }
+    if arm == "fair":
+        overrides["TENANTS"] = ",".join(("heavy", *LIGHTS))
+    t0 = time.perf_counter()
+    light_ttfts, heavy_ttfts = [], []
+    done = {"heavy": 0, "light": 0}
+    sheds = 0
+    async with ServiceUnderTest(overrides) as s:
+        # One discarded probe: lazy first-dispatch costs stay out of
+        # the measured cells.
+        await _one(s.client, "heavy")
+        for _ in range(REPEATS):
+            for tenant, status, ttft, _wall in await _run_load(s):
+                side = "heavy" if tenant == "heavy" else "light"
+                if status == 200:
+                    done[side] += 1
+                    if ttft is not None:
+                        (heavy_ttfts if side == "heavy"
+                         else light_ttfts).append(ttft)
+                else:
+                    sheds += 1
+            await asyncio.sleep(1.0)  # drain the slot pool between reps
+    return {
+        "arm": arm,
+        "light_ttft_p50_ms": (
+            round(pctile(light_ttfts, 0.5) * 1000, 1) if light_ttfts else None
+        ),
+        "light_ttft_p99_ms": (
+            round(pctile(light_ttfts, 0.99) * 1000, 1) if light_ttfts else None
+        ),
+        "heavy_ttft_p99_ms": (
+            round(pctile(heavy_ttfts, 0.99) * 1000, 1) if heavy_ttfts else None
+        ),
+        "light_done": done["light"],
+        "heavy_done": done["heavy"],
+        "sheds": sheds,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+async def main() -> None:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    rows = [await run_arm(arm, dev) for arm in ("edf", "fair")]
+
+    import jax
+
+    backend = jax.default_backend()
+    cols = list(rows[0].keys())
+    print("| " + " | ".join(cols) + " | backend |", file=sys.stderr)
+    print("|" + "---|" * (len(cols) + 1), file=sys.stderr)
+    for row in rows:
+        print(
+            "| " + " | ".join(str(row[c]) for c in cols)
+            + f" | {backend} |",
+            file=sys.stderr,
+        )
+        print(json.dumps({**row, "backend": backend}))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

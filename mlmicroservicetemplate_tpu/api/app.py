@@ -348,9 +348,10 @@ async def _on_cleanup(app: web.Application) -> None:
 
 
 def _sched_fields(request: web.Request) -> dict:
-    """X-Priority / X-Deadline-Ms headers → the scheduling fields the
-    admission controller reads off the feats dict.  Malformed headers
-    are client errors (400), not silently-defaulted surprises."""
+    """X-Priority / X-Deadline-Ms / X-Api-Key / X-Adapter headers → the
+    scheduling fields the admission controller reads off the feats
+    dict.  Malformed headers are client errors (400), not
+    silently-defaulted surprises."""
     out: dict = {}
     p = request.headers.get("X-Priority")
     if p is not None:
@@ -369,13 +370,50 @@ def _sched_fields(request: web.Request) -> dict:
         if not dv > 0:  # also rejects NaN
             raise web.HTTPBadRequest(reason="X-Deadline-Ms must be > 0")
         out["deadline_ms"] = dv
+    # Tenancy (tenancy/accounts.py): classify the request ONCE at the
+    # HTTP edge; everything downstream (quota gate, fair-share queue,
+    # per-tenant metrics) reads feats["tenant"].  No registry = no
+    # tenant field at all — single-tenant feats stay bit-identical.
+    batcher = request.app[K_BATCHER]
+    tenants = getattr(batcher, "tenants", None)
+    if tenants is not None:
+        spec = tenants.classify(request.headers.get("X-Api-Key"))
+        if spec.name:
+            out["tenant"] = spec.name
+        if spec.adapter:
+            out["adapter_id"] = spec.adapter
+    a = request.headers.get("X-Adapter")
+    if a is not None:
+        a = a.strip()
+        if not a:
+            # Explicit opt-out of the tenant's default adapter.
+            out.pop("adapter_id", None)
+        else:
+            pool = getattr(batcher, "adapters", None)
+            if pool is None:
+                raise web.HTTPBadRequest(
+                    reason="X-Adapter requires ADAPTER_DIR to be configured"
+                )
+            if not pool.known(a):
+                raise web.HTTPBadRequest(
+                    reason=f"unknown adapter {a!r} (available: "
+                           f"{', '.join(pool.ids()) or 'none'})"
+                )
+            out["adapter_id"] = a
     return out
 
 
-def _shed_response(e: QueueFullError) -> web.HTTPServiceUnavailable:
+def _shed_response(e: QueueFullError) -> web.HTTPException:
     """503 with Retry-After derived from queue depth × observed batch
-    latency (the batcher stamps retry_after_s on the error)."""
+    latency (the batcher stamps retry_after_s on the error); quota
+    sheds are the caller's fault, not the server's, so they map to 429
+    with the tenant's own window-drain Retry-After."""
     ra = max(1, int(math.ceil(getattr(e, "retry_after_s", None) or 1.0)))
+    if getattr(e, "reason", "") == "quota":
+        return web.HTTPTooManyRequests(
+            reason=str(e) or "tenant quota exhausted, retry later",
+            headers={"Retry-After": str(ra)},
+        )
     return web.HTTPServiceUnavailable(
         reason=str(e) or "overloaded, retry later",
         headers={"Retry-After": str(ra)},
@@ -520,8 +558,9 @@ async def handle_predict(request: web.Request) -> web.StreamResponse:
                 result["prediction"]["text"], item.stop
             )
     except QueueFullError as e:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise _shed_response(e)
+        resp = _shed_response(e)
+        metrics.REQUESTS.labels(bundle.name, str(resp.status)).inc()
+        raise resp
     except DeadlineExceededError:
         metrics.REQUESTS.labels(bundle.name, "504").inc()
         raise _deadline_response()
@@ -675,15 +714,17 @@ async def _open_stream(request: web.Request, feats: dict, item: RawItem,
     try:
         stream_iter = app[K_BATCHER].submit_stream(feats)
     except QueueFullError as e:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise _shed_response(e)
+        resp = _shed_response(e)
+        metrics.REQUESTS.labels(bundle.name, str(resp.status)).inc()
+        raise resp
     events = _delta_stream(bundle, stream_iter, item)
     try:
         first = await events.__anext__()
     except QueueFullError as e:
         await stream_iter.aclose()
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise _shed_response(e)
+        resp = _shed_response(e)
+        metrics.REQUESTS.labels(bundle.name, str(resp.status)).inc()
+        raise resp
     except DeadlineExceededError:
         await stream_iter.aclose()
         metrics.REQUESTS.labels(bundle.name, "504").inc()
@@ -841,8 +882,9 @@ async def _generate_once(request: web.Request, feats: dict, item: RawItem):
         ) else "length"
         return text, finish, n_tok
     except QueueFullError as e:
-        metrics.REQUESTS.labels(bundle.name, "503").inc()
-        raise _shed_response(e)
+        resp = _shed_response(e)
+        metrics.REQUESTS.labels(bundle.name, str(resp.status)).inc()
+        raise resp
     except DeadlineExceededError:
         metrics.REQUESTS.labels(bundle.name, "504").inc()
         raise _deadline_response()
@@ -1484,6 +1526,14 @@ async def handle_status(request: web.Request) -> web.Response:
         # hit/miss/insert counts, per-phase warm seconds, process XLA
         # compile totals — what a fleet spawn or restart actually paid.
         body["compile"] = batcher.compile_status()
+    if hasattr(batcher, "tenancy_status"):
+        # Multi-tenancy (TENANTS/ADAPTER_DIR; docs/multi-tenancy.md):
+        # per-tenant usage/quota headroom, fair-share virtual clocks
+        # and the adapter pool's slot residency.  Absent entirely when
+        # tenancy is off.
+        tstat = batcher.tenancy_status()
+        if tstat is not None:
+            body["tenancy"] = tstat
     # Perf observatory (r20; utils/perfobs.py, docs/observability.md):
     # always-on device busy/bubble + MFU estimate, SLO burn rates —
     # the compact operator view (/debug/perf has the full detail).

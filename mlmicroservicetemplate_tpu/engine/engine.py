@@ -983,10 +983,15 @@ class InferenceEngine:
 
         return usable
 
-    def start_fused(self, feats: dict):
+    def start_fused(self, feats: dict, params=None):
         """Collate + fused prefill-and-first-chunk for ONE stream,
         through the per-request prefix cache when it hits.  Returns
         (state, toks, sampled).  Caller must hold ``self._lock``.
+
+        ``params`` overrides the dispatch tree — the continuous loop
+        passes the adapter-overlaid params (models/lora.py) so a B=1
+        admission prefills through its LoRA delta; None = the base
+        tree, bit-identical to the pre-adapter path.
 
         Cache-hit path: the prompt's longest cached prefix (exact
         token-hash match at a seq-bucket length P) rides in as KV and
@@ -994,6 +999,8 @@ class InferenceEngine:
         Miss path: normal full prefill, after which the prompt DONATES
         its own prefix KV (a single jitted slice of cache rows 0..P —
         free compute, the prefill already produced it)."""
+        if params is None:
+            params = self.params
         row_ids = np.asarray(feats["input_ids"], np.int32)[: int(feats["length"])]
         length = int(feats["length"])
         usable = self._prefix_guard(length)
@@ -1015,7 +1022,7 @@ class InferenceEngine:
                 sp, sampled = self._collate_sample([feats], ids.shape[0])
                 ids, mask = self.replicas.place_batch(ids, mask)
                 state, toks = self._start_prefixed(
-                    self.params, pkv, ids, mask, sp,
+                    params, pkv, ids, mask, sp,
                     self.max_decode_len, self.chunk_tokens, sampled,
                 )
                 # A growing conversation must keep donating: the hit
@@ -1037,7 +1044,7 @@ class InferenceEngine:
         sp, sampled = self._collate_sample([feats], ids.shape[0])
         ids, mask = self.replicas.place_batch(ids, mask)
         state, toks = self._start(
-            self.params, ids, mask, sp,
+            params, ids, mask, sp,
             self.max_decode_len, self.chunk_tokens, sampled,
         )
         if prefix_cache is not None:

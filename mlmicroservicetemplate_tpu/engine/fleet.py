@@ -285,6 +285,11 @@ class ReplicaFleet:
         # at its own counter (spawned replicas inherit it through the
         # indirection in _wire_replica).
         self.external_active = lambda: 0
+        # Multi-tenancy (tenancy/; set retroactively by the Batcher via
+        # set_tenancy AFTER construction — the fleet boots first): ONE
+        # shared TenantRegistry (fleet-wide quota ledger), per-replica
+        # fair-share cursors and adapter pools.  None = tenancy off.
+        self.tenancy: tuple | None = None
 
         # One fleet budget → per-replica pool-authoritative ledgers:
         # each replica admits against its own share of the LIVE split.
@@ -406,7 +411,44 @@ class ReplicaFleet:
         cdl.on_fault = self._on_fault_cb(rep)
         cdl.on_ok = breaker.record_ok
         cdl.external_active = lambda: self.external_active()
+        if self.tenancy is not None:
+            self._apply_tenancy(rep)
         return rep
+
+    def set_tenancy(self, registry, pool, default_weight: float = 1.0
+                    ) -> None:
+        """Attach the tenancy subsystem (Batcher boot): the SHARED
+        registry backs every replica's quota gate (one fleet-wide
+        ledger), while fair-share virtual-time cursors and adapter
+        device stacks are per replica — a replica's dequeue order and
+        LoRA residency are its own.  Applies to live replicas AND every
+        replica spawned later (``_wire_replica``)."""
+        self.tenancy = (registry, pool, float(default_weight))
+        for rep in self.replicas:
+            self._apply_tenancy(rep)
+
+    def _apply_tenancy(self, rep: Replica) -> None:
+        registry, pool, default_w = self.tenancy
+        if registry is not None:
+            from ..tenancy.fairshare import WeightedFairShare
+
+            rep.admission.set_tenants(registry)
+            rep.cdl.tenants = registry
+            rep.cdl.queue.set_fairshare(
+                WeightedFairShare(registry.weights(), default_w)
+            )
+        if pool is not None:
+            from ..tenancy.adapters import AdapterPool
+
+            if getattr(rep.cdl, "spec", False):
+                raise ValueError(
+                    "ADAPTER_DIR does not compose with SPEC_CONTINUOUS"
+                )
+            # Per-replica device stacks over the ONE host dict (loaded
+            # once at boot; replicas never re-read ADAPTER_DIR).
+            rep.cdl.adapters = AdapterPool(
+                pool.host, slots=pool.n_slots, model=pool.model
+            )
 
     def _share_tiers(self, rep: Replica) -> None:
         """Point one replica's engine at the fleet-shared host tier,

@@ -97,6 +97,7 @@ class _Stream:
         "skip", "tokens", "preempted", "t_in", "_removed",
         "blocks", "s_base", "s_lo", "shared_ids", "swap",
         "rid", "t_queued", "t_emit", "done_journaled",
+        "tenant", "adapter_slot",
     )
 
     # Admission-ledger marker: paged mode accounts streams via the
@@ -153,6 +154,13 @@ class _Stream:
         # (_journal_done), and exactly once across the emit site and
         # the release path.
         self.done_journaled = False
+        # Multi-tenancy (tenancy/): the tenant label rides the stream
+        # so fair-share dequeue and per-tenant SLO attribution never
+        # re-derive it, and the adapter pool slot (0 = base weights)
+        # is refcount-held for the stream's whole lifetime — acquired
+        # at submit/adopt, released exactly once in _release.
+        self.tenant = str(feats.get("tenant") or "")
+        self.adapter_slot = 0
 
     def emit(self, item: Any) -> None:
         try:
@@ -473,6 +481,11 @@ class ContinuousDecodeLoop:
         # Shared AdmissionController (set by the Batcher; None when the
         # loop is driven directly, e.g. in tests — defaults apply).
         self.admission = None
+        # Multi-tenancy (tenancy/, set by the Batcher; both None when
+        # TENANTS/ADAPTER_DIR are unset — the loop then builds and
+        # dispatches exactly the pre-tenancy graphs).
+        self.tenants = None   # tenancy.accounts.TenantRegistry
+        self.adapters = None  # tenancy.adapters.AdapterPool
         # Interactive arrivals may preempt batch-class slot holders.
         self.preempt = bool(getattr(cfg, "preempt", True))
         self.preemptions = 0  # observability + test hook
@@ -629,7 +642,7 @@ class ContinuousDecodeLoop:
                 except QueueFullError as e:
                     if e.retry_after_s is None:
                         e.retry_after_s = self._retry_after_s()
-                    self._shed(e.reason)
+                    self._shed(e.reason, st.tenant)
                     raise
                 st.klass, st.deadline, st.kv = klass, deadline, kv
                 sp.set(klass=st.klass, kv=st.kv)
@@ -637,17 +650,38 @@ class ContinuousDecodeLoop:
             if total >= self.max_streams + self.max_stream_queue:
                 victim = self.queue.evict_for(st)
                 if victim is None:
-                    self._shed("queue_full")
+                    if adm is not None:
+                        adm.release_lease(feats)
+                    self._shed("queue_full", st.tenant)
                     raise QueueFullError(
                         f"{total} streams active >= max_streams="
                         f"{self.max_streams}+{self.max_stream_queue} queued",
                         retry_after_s=self._retry_after_s(),
                     )
-                self._shed("queue_full")
+                self._shed("queue_full", victim.tenant)
                 self._finish(victim, QueueFullError(
                     "shed for higher-priority stream",
                     retry_after_s=self._retry_after_s(),
                 ))
+            if self.adapters is not None and feats.get("adapter_id"):
+                # Pin the LoRA pool slot for the stream's lifetime —
+                # AFTER quota/capacity (a shed must not churn the pool)
+                # and BEFORE _admitted (no slot, no admission).  A full
+                # pool sheds honestly rather than silently serving base.
+                from ..tenancy.adapters import AdapterBusy
+
+                try:
+                    st.adapter_slot = self.adapters.acquire(
+                        str(feats["adapter_id"])
+                    )
+                except AdapterBusy as e:
+                    if adm is not None:
+                        adm.release_lease(feats)
+                    self._shed("adapter_pool", st.tenant)
+                    raise QueueFullError(
+                        str(e), reason="adapter_pool",
+                        retry_after_s=e.retry_after_s,
+                    ) from e
             self._admitted += 1
             # Write-ahead admission record (runtime/durability.py):
             # journaled BEFORE the stream can produce anything, so a
@@ -733,6 +767,13 @@ class ContinuousDecodeLoop:
             self._drop_swap(st, disk_too=True)  # terminal: no reader left
             if self.admission is not None:
                 self.admission.release(st)
+            if st.adapter_slot and self.adapters is not None:
+                # Drop the LoRA pool refcount exactly once, at the same
+                # terminal point as the quota lease — a preempted stream
+                # keeps its slot across checkpoints (its resume decodes
+                # through the same delta).
+                self.adapters.release(st.adapter_slot)
+                st.adapter_slot = 0
             dt = time.monotonic() - st.t_in
             tr = tracing.tracer()
             if tr is not None:
@@ -753,8 +794,12 @@ class ContinuousDecodeLoop:
                 # consumer-side view stays sane.
                 self._admitted -= 1
 
-    def _shed(self, reason: str) -> None:
+    def _shed(self, reason: str, tenant: str = "") -> None:
         metrics.SHED.labels(self.engine.bundle.name, reason).inc()
+        if self.tenants is not None and reason != "quota":
+            # Per-tenant attribution (bounded label; "" → anon).  Quota
+            # sheds are already attributed at the admission gate.
+            self.tenants.note_shed(tenant, reason)
         if self._flight is not None:
             self._flight.event("shed", reason=reason)
 
@@ -1168,7 +1213,7 @@ class ContinuousDecodeLoop:
         from ..scheduler.policy import DeadlineExceededError
 
         for st in self.queue.expire():
-            self._shed("deadline")
+            self._shed("deadline", st.tenant)
             self._finish(st, DeadlineExceededError(
                 "deadline passed while queued; stream shed before dispatch"
             ))
@@ -1345,6 +1390,8 @@ class ContinuousDecodeLoop:
         is ordinary re-admission: re-estimate the KV footprint against
         THIS replica's pool, count it against this loop's admission,
         queue it.  Called from the dead replica's loop thread."""
+        from ..scheduler.policy import QueueFullError
+
         entry = getattr(st, "swap", None)
         if entry is not None and not self._is_disk_entry(entry):
             tier = self._host_tier()
@@ -1366,6 +1413,38 @@ class ContinuousDecodeLoop:
             st.kv = self.admission.kv_bytes_for_resume(
                 st.feats, swap_tokens=self._swap_tokens(st)
             )
+        # Re-pin the LoRA adapter against THIS loop's pool: the slot
+        # index harvested from the dead replica indexes a pool that no
+        # longer exists.  Failure ends the stream honestly — resuming
+        # an adapter stream through base weights would silently change
+        # its tokens.
+        st.adapter_slot = 0
+        aid = str(st.feats.get("adapter_id") or "")
+        if aid:
+            err = None
+            if self.adapters is None:
+                err = QueueFullError(
+                    f"adopting replica has no adapter pool for {aid!r}",
+                    reason="adapter_pool", retry_after_s=1.0,
+                )
+            else:
+                from ..tenancy.adapters import AdapterBusy
+
+                try:
+                    st.adapter_slot = self.adapters.acquire(aid)
+                except (AdapterBusy, KeyError) as e:
+                    retry = getattr(e, "retry_after_s", 1.0)
+                    err = QueueFullError(
+                        str(e), reason="adapter_pool", retry_after_s=retry,
+                    )
+            if err is not None:
+                self._shed("adapter_pool", st.tenant)
+                try:
+                    st.loop.call_soon_threadsafe(self._inc_admitted)
+                except RuntimeError:
+                    self._admitted += 1
+                self._finish(st, err)
+                return
         try:
             st.loop.call_soon_threadsafe(self._inc_admitted)
         except RuntimeError:
@@ -1433,6 +1512,11 @@ class ContinuousDecodeLoop:
         or end the stream if nothing remains to resume."""
         if self.admission is not None:
             self.admission.release(st)
+        if st.adapter_slot and self.adapters is not None:
+            # The corpse's LoRA slot ref: the adopter re-pins against
+            # ITS pool, so this one must drain with the dead replica.
+            self.adapters.release(st.adapter_slot)
+            st.adapter_slot = 0
         if not self._checkpoint_for_resume(st):
             self._finish(st)
             return None
@@ -1741,6 +1825,8 @@ class ContinuousDecodeLoop:
                 )
                 if self.slo is not None:
                     self.slo.note("tbt", st.klass, gap)
+                if self.tenants is not None:
+                    self.tenants.note_latency(st.tenant, "tbt", st.klass, gap)
             else:
                 ttft = now - st.t_in
                 self.ttft_ewma_s = (
@@ -1749,7 +1835,39 @@ class ContinuousDecodeLoop:
                 )
                 if self.slo is not None:
                     self.slo.note("ttft", st.klass, ttft)
+                if self.tenants is not None:
+                    self.tenants.note_latency(st.tenant, "ttft", st.klass, ttft)
             st.t_emit = now
+
+    # -- adapter dispatch params ---------------------------------------
+
+    def _slot_rows(self) -> list[int]:
+        """Per-slot adapter index vector for a full-width decode
+        dispatch: row ``i`` decodes through the adapter pinned by the
+        stream active in slot ``i`` (0 = base / free slot)."""
+        rows = [0] * self.n_slots
+        for slot, st in self.active.items():
+            if 0 <= slot < self.n_slots:
+                rows[slot] = st.adapter_slot
+        return rows
+
+    def _mp(self, n: int | None = None, rows: list[int] | None = None):
+        """The params tree for one dispatch.
+
+        No adapter pool → the engine's base tree, the SAME object every
+        call, so traced graphs and executable-cache keys are bit-
+        identical to the pre-adapter build (the TENANTS-unset pin).
+        With a pool: overlay the slot stacks with an explicit per-row
+        adapter index vector (``rows``), an all-base vector of width
+        ``n`` (warm paths, empty-state builds), or — neither given —
+        the live per-slot vector (full-width decode dispatches).
+        Slot contents change under install/evict; shapes never do, so
+        serving never recompiles (CompileWindow-pinned)."""
+        if self.adapters is None:
+            return self.engine.params
+        if rows is None:
+            rows = [0] * int(n) if n is not None else self._slot_rows()
+        return self.adapters.overlay(self.engine.params, rows)
 
     # -- admission -----------------------------------------------------
 
@@ -1827,7 +1945,10 @@ class ContinuousDecodeLoop:
                         # on) — TTFT = solo serving; the slot insert
                         # pads narrower states up to the slot shapes.
                         state1, toks, sampled = eng.dispatch_guard(
-                            "prefill", lambda: eng.start_fused(st.feats)
+                            "prefill", lambda: eng.start_fused(
+                                st.feats,
+                                params=self._mp(rows=[st.adapter_slot]),
+                            )
                         )
                     except Exception as e:
                         self._fail_streams([st], e)
@@ -1859,10 +1980,13 @@ class ContinuousDecodeLoop:
                 ids, mask, _ = eng._collate_text(feats_list)
                 sp, sampled = eng._collate_sample(feats_list, ids.shape[0])
                 ids, mask = eng.replicas.place_batch(ids, mask)
+                wrows = [st.adapter_slot for st in ok]
+                wrows += [0] * (int(ids.shape[0]) - len(wrows))
+                wparams = self._mp(rows=wrows)
                 state1, toks = eng.dispatch_guard(
                     "prefill",
                     lambda: eng._start(
-                        eng.params, ids, mask, sp,
+                        wparams, ids, mask, sp,
                         eng.max_decode_len, eng.chunk_tokens, sampled,
                     ),
                 )
@@ -1981,10 +2105,13 @@ class ContinuousDecodeLoop:
                 ids, mask, sp, sampled = collate_place(
                     pad_feats([st.feats for st, _, _ in misses])
                 )
+                mrows = [st.adapter_slot for st, _, _ in misses]
+                mrows += [0] * (int(ids.shape[0]) - len(mrows))
+                mparams = self._mp(rows=mrows)
                 state1, toks = eng.dispatch_guard(
                     "prefill",
                     lambda: eng._start(
-                        eng.params, ids, mask, sp,
+                        mparams, ids, mask, sp,
                         eng.max_decode_len, eng.chunk_tokens, sampled,
                     ),
                 )
@@ -2011,17 +2138,20 @@ class ContinuousDecodeLoop:
                 ids, mask, sp, sampled = collate_place(
                     pad_feats(suffix_feats)
                 )
+                hrows = [st.adapter_slot for st, *_ in members]
+                hrows += [0] * (int(ids.shape[0]) - len(hrows))
+                hparams = self._mp(rows=hrows)
 
                 def start_hits():
                     if len(members) == 1:
                         return eng._start_prefixed(
-                            eng.params, members[0][4], ids, mask, sp,
+                            hparams, members[0][4], ids, mask, sp,
                             eng.max_decode_len, eng.chunk_tokens, sampled,
                         )
                     pkvs = tuple(pkv for _, _, _, _, pkv in members)
                     pkvs = pkvs + (pkvs[0],) * (ids.shape[0] - len(pkvs))
                     return eng._start_prefixed_wave(
-                        eng.params, pkvs, ids, mask, sp,
+                        hparams, pkvs, ids, mask, sp,
                         eng.max_decode_len, eng.chunk_tokens, sampled,
                     )
 
@@ -2333,7 +2463,8 @@ class ContinuousDecodeLoop:
                 with eng._lock:
                     # graftlint: unguarded(detached empty-state template build — no stream tokens flow; failures classify via the caller's _fail_streams, and guarding would renumber the pinned prefill_chunk schedules)
                     job.state = self._empty_prefill_fn()(
-                        eng.params, 1, job.s_total, eng.max_decode_len
+                        self._mp(rows=[st.adapter_slot]), 1, job.s_total,
+                        eng.max_decode_len,
                     )
                     if p_len:
                         job.state = self._seed_prefix_state(
@@ -2405,11 +2536,12 @@ class ContinuousDecodeLoop:
                 job.table_row[: len(job.sb.ids)] = job.sb.ids
                 if self._state is None:
                     self._build_empty_state()
+                jparams = self._mp(rows=[job.st.adapter_slot])
                 with eng._lock:
                     self._state = eng.dispatch_guard(
                         "prefill_chunk",
                         lambda: self._paged_prefill_fn()(
-                            eng.params, self._state,
+                            jparams, self._state,
                             jnp.asarray(job.table_row), ids_w, mask_w,
                             np.int32(start),
                         ),
@@ -2417,11 +2549,12 @@ class ContinuousDecodeLoop:
                 if self.admission is not None:
                     self.admission.note_pool()
             else:
+                jparams = self._mp(rows=[job.st.adapter_slot])
                 with eng._lock:
                     job.state = eng.dispatch_guard(
                         "prefill_chunk",
                         lambda: self._prefill_fn()(
-                            eng.params, job.state, ids_w, mask_w,
+                            jparams, job.state, ids_w, mask_w,
                             np.int32(start)
                         ),
                     )
@@ -2565,7 +2698,7 @@ class ContinuousDecodeLoop:
             ):
                 self._prefilling.remove(job)
                 self._drop_job_resources(job)
-                self._shed("deadline")
+                self._shed("deadline", st.tenant)
                 self._finish(st, DeadlineExceededError(
                     "deadline passed mid-prefill; stream shed before "
                     "its first token"
@@ -2666,7 +2799,7 @@ class ContinuousDecodeLoop:
                 )
                 with eng._lock:
                     self._state = self._paged_prefill_fn()(
-                        eng.params, self._state, jnp.asarray(table_row),
+                        self._mp(n=1), self._state, jnp.asarray(table_row),
                         ids_w, mask_w, np.int32(0),
                     )
                     self._state = self._paged_handoff_fn()(
@@ -2685,10 +2818,10 @@ class ContinuousDecodeLoop:
                 continue
             with eng._lock:
                 st1 = self._empty_prefill_fn()(
-                    eng.params, 1, s, eng.max_decode_len
+                    self._mp(n=1), 1, s, eng.max_decode_len
                 )
                 self._prefill_fn()(
-                    eng.params, st1, ids_w, mask_w, np.int32(0)
+                    self._mp(n=1), st1, ids_w, mask_w, np.int32(0)
                 )
 
     def _build_empty_state(self) -> None:
@@ -2711,7 +2844,8 @@ class ContinuousDecodeLoop:
             ids, mask = eng.replicas.place_batch(ids, mask)
             # graftlint: unguarded(all-dead template build carries no stream data; it rebuilds at recovery, where guarding would renumber every deterministic FAULT_SPEC schedule the chaos suites pin)
             template, _ = eng._start(
-                eng.params, ids, mask, sp, eng.max_decode_len, eng.chunk_tokens, False
+                self._mp(n=int(ids.shape[0])), ids, mask, sp,
+                eng.max_decode_len, eng.chunk_tokens, False,
             )
             if self.spec:
                 template = self._shared_jit(
@@ -4158,6 +4292,7 @@ class ContinuousDecodeLoop:
             use_sample = bool(self.sampled_slots)
             import jax.numpy as jnp
 
+            dparams = self._mp()
             with eng._lock:
                 if table is None:
                     table = jnp.asarray(self._table)
@@ -4165,7 +4300,7 @@ class ContinuousDecodeLoop:
                     self._state, toks, hist, nc = eng.dispatch_guard(
                         "chunk",
                         lambda: self._window_fn()(
-                            eng.params, self._state, table,
+                            dparams, self._state, table,
                             eng.chunk_tokens, w, use_sample,
                         ),
                     )
@@ -4175,7 +4310,7 @@ class ContinuousDecodeLoop:
                     self._state, toks = eng.dispatch_guard(
                         "chunk",
                         lambda: self._paged_chunk_fn()(
-                            eng.params, self._state, table,
+                            dparams, self._state, table,
                             eng.chunk_tokens, use_sample,
                         ),
                     )
@@ -4185,6 +4320,10 @@ class ContinuousDecodeLoop:
             self._note_dispatched(entry)
             return
         use_sample = bool(self.sampled_slots)
+        # Spec mode stays on the base tree: adapters do not compose
+        # with the draft→verify executable (the batcher rejects the
+        # combination at boot).
+        dparams = eng.params if self.spec else self._mp()
         with eng._lock:
             if self.spec:
                 # One batched draft→verify chunk: every live row emits
@@ -4203,7 +4342,7 @@ class ContinuousDecodeLoop:
                 self._state, toks, hist, nc = eng.dispatch_guard(
                     "chunk",
                     lambda: self._window_fn()(
-                        eng.params, self._state, eng.chunk_tokens, w,
+                        dparams, self._state, eng.chunk_tokens, w,
                         use_sample,
                     ),
                 )
@@ -4213,7 +4352,7 @@ class ContinuousDecodeLoop:
                 self._state, toks = eng.dispatch_guard(
                     "chunk",
                     lambda: eng._gen_chunk(
-                        eng.params, self._state, eng.chunk_tokens,
+                        dparams, self._state, eng.chunk_tokens,
                         use_sample,
                     ),
                 )
@@ -4473,7 +4612,7 @@ class ContinuousDecodeLoop:
                         sp, _ = eng._collate_sample(feats_list, ids.shape[0])
                         ids, mask = eng.replicas.place_batch(ids, mask)
                         state1, _ = eng._start(
-                            eng.params, ids, mask, sp,
+                            self._mp(n=int(ids.shape[0])), ids, mask, sp,
                             eng.max_decode_len, eng.chunk_tokens, flag,
                         )
                         do_insert(state1, ids, mask, s)
@@ -4489,7 +4628,8 @@ class ContinuousDecodeLoop:
                     jax.device_get(out)
                 else:
                     self._state, toks = eng._gen_chunk(
-                        eng.params, self._state, eng.chunk_tokens, flag
+                        self._mp(n=self.n_slots), self._state,
+                        eng.chunk_tokens, flag,
                     )
                     jax.device_get(toks)
         self._warm_windows(warm_sampled)
@@ -4509,7 +4649,7 @@ class ContinuousDecodeLoop:
                     sp, _ = eng._collate_sample(feats_list, ids.shape[0])
                     ids, mask = eng.replicas.place_batch(ids, mask)
                     state1, _ = eng._start(
-                        eng.params, ids, mask, sp,
+                        self._mp(n=int(ids.shape[0])), ids, mask, sp,
                         eng.max_decode_len, eng.chunk_tokens, False,
                     )
                     do_insert(state1, ids, mask, s)
@@ -4544,7 +4684,7 @@ class ContinuousDecodeLoop:
                 sp, _ = eng._collate_sample([feats_max], ids.shape[0])
                 ids, mask = eng.replicas.place_batch(ids, mask)
                 template, _ = eng._start(
-                    eng.params, ids, mask, sp,
+                    self._mp(n=int(ids.shape[0])), ids, mask, sp,
                     eng.max_decode_len, eng.chunk_tokens, False,
                 )
             for p_len in eng.seq_buckets:
@@ -4564,7 +4704,7 @@ class ContinuousDecodeLoop:
                         ssp, _ = eng._collate_sample([sfeats], sids.shape[0])
                         sids, smask = eng.replicas.place_batch(sids, smask)
                         st1, _ = eng._start_prefixed(
-                            eng.params, pkv, sids, smask, ssp,
+                            self._mp(n=1), pkv, sids, smask, ssp,
                             eng.max_decode_len, eng.chunk_tokens, False,
                         )
                         # Spec mode warms the init_spec_fn-recasting
@@ -4586,7 +4726,8 @@ class ContinuousDecodeLoop:
                                 (False, True) if warm_sampled else (False,)
                             ):
                                 stw, tw = eng._start_prefixed_wave(
-                                    eng.params, pkvs, wids, wmask, wsp,
+                                    self._mp(n=int(wids.shape[0])),
+                                    pkvs, wids, wmask, wsp,
                                     eng.max_decode_len, eng.chunk_tokens,
                                     flag,
                                 )
@@ -4687,7 +4828,7 @@ class ContinuousDecodeLoop:
                         sp, _ = eng._collate_sample(feats_list, ids.shape[0])
                         ids, mask = eng.replicas.place_batch(ids, mask)
                         state1, _ = eng._start(
-                            eng.params, ids, mask, sp,
+                            self._mp(n=int(ids.shape[0])), ids, mask, sp,
                             eng.max_decode_len, eng.chunk_tokens, False,
                         )
                         self._state = self._paged_insert_fn()(
@@ -4700,8 +4841,8 @@ class ContinuousDecodeLoop:
         for flag in (False, True) if warm_sampled else (False,):
             with eng._lock:
                 self._state, toks = self._paged_chunk_fn()(
-                    eng.params, self._state, jnp.asarray(self._table),
-                    eng.chunk_tokens, flag,
+                    self._mp(n=self.n_slots), self._state,
+                    jnp.asarray(self._table), eng.chunk_tokens, flag,
                 )
                 jax.device_get(toks)
         self._warm_windows(warm_sampled)
@@ -4784,14 +4925,14 @@ class ContinuousDecodeLoop:
                 with eng._lock:
                     if self.paged:
                         self._state, toks, _, _ = self._window_fn()(
-                            eng.params, self._state,
+                            self._mp(n=self.n_slots), self._state,
                             jnp.asarray(self._table), eng.chunk_tokens, w,
                             flag,
                         )
                     else:
                         self._state, toks, _, _ = self._window_fn()(
-                            eng.params, self._state, eng.chunk_tokens, w,
-                            flag,
+                            self._mp(n=self.n_slots), self._state,
+                            eng.chunk_tokens, w, flag,
                         )
                     jax.device_get(toks)
             w *= 2
@@ -4806,6 +4947,7 @@ class ContinuousDecodeLoop:
 
         eng = self.engine
         table = jnp.asarray(self._table)
+        wp = self._mp(n=self.n_slots)
 
         def wall(k: int) -> float:
             t0 = _time.perf_counter()
@@ -4814,7 +4956,7 @@ class ContinuousDecodeLoop:
                 for _ in range(k):
                     # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                     s, toks = self._paged_chunk_fn()(
-                        eng.params, s, table, eng.chunk_tokens, False
+                        wp, s, table, eng.chunk_tokens, False
                     )
                 # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                 jax.device_get(toks)
@@ -4860,6 +5002,7 @@ class ContinuousDecodeLoop:
         import jax
 
         eng = self.engine
+        wp = eng.params if self.spec else self._mp(n=self.n_slots)
 
         def wall(k: int) -> float:
             t0 = _time.perf_counter()
@@ -4875,7 +5018,7 @@ class ContinuousDecodeLoop:
                     else:
                         # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                         s, toks = eng._gen_chunk(
-                            eng.params, s, eng.chunk_tokens, False
+                            wp, s, eng.chunk_tokens, False
                         )
                 # graftlint: unguarded(warm-time RTT calibration probe — the raw wire is the measurement; a guard's bookkeeping is the thing being measured)
                 jax.device_get(toks)

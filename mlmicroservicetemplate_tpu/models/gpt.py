@@ -30,6 +30,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from . import lora
 from .common import (
     Params,
     dense,
@@ -111,10 +112,16 @@ def init_params(key, cfg: GPTConfig = GPTConfig()) -> Params:
     return params
 
 
-def _qkv(p, cfg: GPTConfig, x):
-    qkv = dense(p["qkv"], x)
+def _qkv(p, cfg: GPTConfig, x, ad=None, li=0):
+    qkv = lora.apply(ad, "qkv", li, x, dense(p["qkv"], x))
     q, k, v = jnp.split(qkv, 3, axis=-1)
     return (split_heads(t, cfg.num_heads) for t in (q, k, v))
+
+
+def _attn_out(p, x, ad=None, li=0):
+    """Attention output projection (+ per-row LoRA delta when serving
+    a ``__adapters__`` overlay; models/lora.py)."""
+    return lora.apply(ad, "out", li, x, dense(p["out"], x))
 
 
 def _logits(params: Params, cfg: GPTConfig, x) -> jax.Array:
@@ -157,10 +164,11 @@ def forward_hidden(
     if p_len:
         pre = jnp.ones((1, 1, s, p_len), bool)  # prefix fully visible
         mask = jnp.concatenate([jnp.broadcast_to(pre, (b, 1, s, p_len)), mask], axis=-1)
+    ad = lora.adapter_tables(params)
     kv = []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
-        q, k, v = _qkv(layer["attn"], cfg, h)
+        q, k, v = _qkv(layer["attn"], cfg, h, ad, li)
         if collect_kv:
             kv.append((k, v))
         if p_len:
@@ -168,7 +176,7 @@ def forward_hidden(
             k = jnp.concatenate([jnp.broadcast_to(pk.astype(k.dtype), (b,) + pk.shape[1:]), k], axis=1)
             v = jnp.concatenate([jnp.broadcast_to(pv.astype(v.dtype), (b,) + pv.shape[1:]), v], axis=1)
         ctx = mha_attention(q, k, v, mask=mask)
-        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        x = x + _attn_out(layer["attn"], merge_heads(ctx), ad, li)
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
     x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
@@ -285,16 +293,17 @@ def _decode_step(params: Params, cfg: GPTConfig, state: GPTState, sample: bool =
     key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
     attn_mask = (key_valid != 0)[:, None, None, :]  # [B,1,1,total]
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
-        q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B,1,H,D]
+        q, k1, v1 = _qkv(layer["attn"], cfg, h, ad, li)  # [B,1,H,D]
         ck = state.cache_k[li].at[rows, t].set(k1[:, 0], mode="drop")
         cv = state.cache_v[li].at[rows, t].set(v1[:, 0], mode="drop")
         new_k.append(ck)
         new_v.append(cv)
         ctx = mha_attention(q, ck, cv, mask=attn_mask)
-        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        x = x + _attn_out(layer["attn"], merge_heads(ctx), ad, li)
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
     x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
@@ -346,16 +355,17 @@ def multi_step(
     in_window = (pos_k >= t[:, None, None]) & (pos_k <= pos_w[:, :, None])
     mask = (base_valid | in_window)[:, None]  # [B, 1, D, total]
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
-        q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B, D, H, Dh]
+        q, k1, v1 = _qkv(layer["attn"], cfg, h, ad, li)  # [B, D, H, Dh]
         ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
         cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
         new_k.append(ck)
         new_v.append(cv)
         ctx = mha_attention(q, ck, cv, mask=mask)
-        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        x = x + _attn_out(layer["attn"], merge_heads(ctx), ad, li)
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
     x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
@@ -479,10 +489,11 @@ def _paged_decode_step(
     key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
     attn_mask = (key_valid != 0)[:, None, None, :]
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
-        q, k1, v1 = _qkv(layer["attn"], cfg, h)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h, ad, li)
         ck = paged_write_token(state.cache_k[li], table, t, k1[:, 0], bs)
         cv = paged_write_token(state.cache_v[li], table, t, v1[:, 0], bs)
         new_k.append(ck)
@@ -504,7 +515,7 @@ def _paged_decode_step(
             kd = gather_pages(ck, table, bs)
             vd = gather_pages(cv, table, bs)
             ctx = mha_attention(q, kd, vd, mask=attn_mask)
-        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        x = x + _attn_out(layer["attn"], merge_heads(ctx), ad, li)
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
     x = layernorm(params["final_ln"], x, eps=cfg.ln_eps)
@@ -645,16 +656,17 @@ def prefill_chunk(
     x = x + embed(params["wpe"], jnp.minimum(pos_w, cfg.max_position - 1), dtype)
     mask = _window_mask(state.key_valid != 0, chunk_mask, start)
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
-        q, k1, v1 = _qkv(layer["attn"], cfg, h)  # [B, C, H, D]
+        q, k1, v1 = _qkv(layer["attn"], cfg, h, ad, li)  # [B, C, H, D]
         ck = state.cache_k[li].at[rows, pos_w].set(k1, mode="drop")
         cv = state.cache_v[li].at[rows, pos_w].set(v1, mode="drop")
         new_k.append(ck)
         new_v.append(cv)
         ctx = mha_attention(q, ck, cv, mask=mask)
-        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        x = x + _attn_out(layer["attn"], merge_heads(ctx), ad, li)
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
     key_valid = state.key_valid.at[rows, pos_w].set(
@@ -694,10 +706,11 @@ def paged_prefill_chunk(
     base_valid = jnp.broadcast_to(jnp.arange(total)[None, :] < start, (b, total))
     mask = _window_mask(base_valid, chunk_mask, start)
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = layernorm(layer["ln1"], x, eps=cfg.ln_eps)
-        q, k1, v1 = _qkv(layer["attn"], cfg, h)
+        q, k1, v1 = _qkv(layer["attn"], cfg, h, ad, li)
         ck = scatter_pages(state.cache_k[li], table_row, k1[0], bs, start=start)
         cv = scatter_pages(state.cache_v[li], table_row, v1[0], bs, start=start)
         new_k.append(ck)
@@ -705,7 +718,7 @@ def paged_prefill_chunk(
         kd = gather_pages(ck, table_row[None], bs)
         vd = gather_pages(cv, table_row[None], bs)
         ctx = mha_attention(q, kd, vd, mask=mask)
-        x = x + dense(layer["attn"]["out"], merge_heads(ctx))
+        x = x + _attn_out(layer["attn"], merge_heads(ctx), ad, li)
         h = layernorm(layer["ln2"], x, eps=cfg.ln_eps)
         x = x + dense(layer["mlp"]["down"], gelu_new(dense(layer["mlp"]["up"], h)))
     return state._replace(cache_k=new_k, cache_v=new_v)

@@ -33,6 +33,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from . import lora
 from .common import (
     Params,
     dense,
@@ -177,6 +178,12 @@ def _split(x: jax.Array, n_heads: int) -> jax.Array:
     return x.reshape(b, s, n_heads, d // n_heads)
 
 
+def _aproj(a, ad, name: str, li: int, x):
+    """One attention projection (+ per-row LoRA delta when serving a
+    ``__adapters__`` overlay; models/lora.py)."""
+    return lora.apply(ad, name, li, x, dense(a[name], x))
+
+
 # ---------------------------------------------------------------------------
 # prefill
 
@@ -249,13 +256,14 @@ def forward_hidden(
         mask = jnp.concatenate(
             [jnp.broadcast_to(pre, (b, 1, s, p_len)), mask], axis=-1
         )
+    ad = lora.adapter_tables(params)
     kv = []
     for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
-        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
-        k = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
-        v = _split(dense(a["v"], h), cfg.num_kv_heads)
+        q = _apply_rope(_split(_aproj(a, ad, "q", li, h), cfg.num_heads), cos, sin)
+        k = _apply_rope(_split(_aproj(a, ad, "k", li, h), cfg.num_kv_heads), cos, sin)
+        v = _split(_aproj(a, ad, "v", li, h), cfg.num_kv_heads)
         if collect_kv:
             kv.append((k, v))
         if p_len:
@@ -270,7 +278,7 @@ def forward_hidden(
         ctx = mha_attention(
             q, _repeat_kv(k, cfg.n_rep), _repeat_kv(v, cfg.n_rep), mask=mask
         )
-        x = x + dense(a["o"], merge_heads(ctx))
+        x = x + _aproj(a, ad, "o", li, merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
@@ -450,19 +458,20 @@ def _decode_step(params: Params, cfg: LlamaConfig, state: GPTState, sample: bool
     key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
     attn_mask = (key_valid != 0)[:, None, None, :]
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
-        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
-        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
-        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        q = _apply_rope(_split(_aproj(a, ad, "q", li, h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(_aproj(a, ad, "k", li, h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(_aproj(a, ad, "v", li, h), cfg.num_kv_heads)
         ck = _write_kv(state.cache_k[li], rows, t, k1[:, 0], dtype)
         cv = _write_kv(state.cache_v[li], rows, t, v1[:, 0], dtype)
         new_k.append(ck)
         new_v.append(cv)
         ctx = _cache_attention(cfg, q, ck, cv, attn_mask)
-        x = x + dense(a["o"], merge_heads(ctx))
+        x = x + _aproj(a, ad, "o", li, merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
@@ -518,19 +527,20 @@ def multi_step(
     in_window = (pos_k >= t[:, None, None]) & (pos_k <= pos_w[:, :, None])
     mask = (base_valid | in_window)[:, None]  # [B, 1, D, total]
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
-        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
-        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
-        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        q = _apply_rope(_split(_aproj(a, ad, "q", li, h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(_aproj(a, ad, "k", li, h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(_aproj(a, ad, "v", li, h), cfg.num_kv_heads)
         ck = _write_kv(state.cache_k[li], rows, pos_w, k1, dtype)
         cv = _write_kv(state.cache_v[li], rows, pos_w, v1, dtype)
         new_k.append(ck)
         new_v.append(cv)
         ctx = _cache_attention(cfg, q, ck, cv, mask)
-        x = x + dense(a["o"], merge_heads(ctx))
+        x = x + _aproj(a, ad, "o", li, merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
@@ -670,19 +680,20 @@ def _paged_decode_step(params: Params, cfg: LlamaConfig, state, table,
     cos, sin = cos[:, None, None, :], sin[:, None, None, :]
     key_valid = state.key_valid.at[rows, t].set(1, mode="drop")
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
-        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
-        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
-        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        q = _apply_rope(_split(_aproj(a, ad, "q", li, h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(_aproj(a, ad, "k", li, h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(_aproj(a, ad, "v", li, h), cfg.num_kv_heads)
         ck = _paged_write_kv(state.cache_k[li], table, t, k1[:, 0], bs, dtype)
         cv = _paged_write_kv(state.cache_v[li], table, t, v1[:, 0], bs, dtype)
         new_k.append(ck)
         new_v.append(cv)
         ctx = _paged_cache_attention(cfg, q, ck, cv, table, key_valid, bs)
-        x = x + dense(a["o"], merge_heads(ctx))
+        x = x + _aproj(a, ad, "o", li, merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
@@ -806,19 +817,20 @@ def prefill_chunk(
     cos, sin = cos[:, :, None, :], sin[:, :, None, :]
     mask = _window_mask(state.key_valid != 0, chunk_mask, start)
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
-        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
-        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
-        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        q = _apply_rope(_split(_aproj(a, ad, "q", li, h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(_aproj(a, ad, "k", li, h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(_aproj(a, ad, "v", li, h), cfg.num_kv_heads)
         ck = _write_kv(state.cache_k[li], rows, pos_w, k1, dtype)
         cv = _write_kv(state.cache_v[li], rows, pos_w, v1, dtype)
         new_k.append(ck)
         new_v.append(cv)
         ctx = _cache_attention(cfg, q, ck, cv, mask)
-        x = x + dense(a["o"], merge_heads(ctx))
+        x = x + _aproj(a, ad, "o", li, merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))
@@ -870,13 +882,14 @@ def paged_prefill_chunk(
     base_valid = jnp.broadcast_to(jnp.arange(total)[None, :] < start, (b, total))
     mask = _window_mask(base_valid, chunk_mask, start)
 
+    ad = lora.adapter_tables(params)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rmsnorm(layer["attn_ln"], x, eps=cfg.rms_eps)
         a = layer["attn"]
-        q = _apply_rope(_split(dense(a["q"], h), cfg.num_heads), cos, sin)
-        k1 = _apply_rope(_split(dense(a["k"], h), cfg.num_kv_heads), cos, sin)
-        v1 = _split(dense(a["v"], h), cfg.num_kv_heads)
+        q = _apply_rope(_split(_aproj(a, ad, "q", li, h), cfg.num_heads), cos, sin)
+        k1 = _apply_rope(_split(_aproj(a, ad, "k", li, h), cfg.num_kv_heads), cos, sin)
+        v1 = _split(_aproj(a, ad, "v", li, h), cfg.num_kv_heads)
         ck = _paged_scatter_entry(state.cache_k[li], table_row, k1[0], bs, start, dtype)
         cv = _paged_scatter_entry(state.cache_v[li], table_row, v1[0], bs, start, dtype)
         new_k.append(ck)
@@ -897,7 +910,7 @@ def paged_prefill_chunk(
                 _repeat_kv(gather_pages(cv, table_row[None], bs), cfg.n_rep),
                 mask=mask,
             )
-        x = x + dense(a["o"], merge_heads(ctx))
+        x = x + _aproj(a, ad, "o", li, merge_heads(ctx))
         h = rmsnorm(layer["mlp_ln"], x, eps=cfg.rms_eps)
         m = layer["mlp"]
         x = x + dense(m["down"], jax.nn.silu(dense(m["gate"], h)) * dense(m["up"], h))

@@ -1,0 +1,61 @@
+"""Batched multi-adapter (LoRA) delta math (docs/multi-tenancy.md).
+
+The serving loop attaches stacked adapter weights to the params dict
+under ``"__adapters__"`` — the same overlay precedent as
+``"__prefix__"`` (gpt.py): absent key = the traced graph is IDENTICAL
+to the base model (the bit-identical-default pin), present key = every
+projection gains a per-row low-rank delta gathered by a per-row slot
+index, so ONE dispatch serves rows running different adapters.
+
+Overlay layout (built by ``tenancy.adapters.AdapterPool.overlay``)::
+
+    {
+      "rows": int32 [B]          # per-row adapter slot (0 = zero delta)
+      "<proj>": {                # e.g. "qkv"/"out" (gpt), "q".."o" (llama)
+        "a": f32 [S, L, d_in, r],   # slot-stacked LoRA A (slot 0 = zeros)
+        "b": f32 [S, L, r, d_out],  # slot-stacked LoRA B (scale folded in)
+      },
+    }
+
+``S`` (slot count) and ``r`` (max rank, zero-padded) are FIXED at pool
+build, so loading/evicting adapters swaps array CONTENTS (same shapes)
+and the serving executables never recompile (CompileWindow-pinned).
+Slot 0 is all-zero: ``adapter_id=None`` rows ride the same batched
+dispatch and produce base-model tokens (pinned).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def adapter_tables(params):
+    """The ``__adapters__`` overlay when the caller attached one."""
+    return params.get("__adapters__") if isinstance(params, dict) else None
+
+
+def delta(ad, name: str, li: int, x):
+    """Per-row LoRA delta for projection ``name`` at layer ``li``, or
+    None when no overlay / the projection isn't adapted.
+
+    ``x`` is the projection INPUT ``[B, T, d_in]``; the result is the
+    ``[B, T, d_out]`` term to add to the dense output.  Row ``i`` uses
+    adapter slot ``rows[i]`` — two batched einsums through the row's
+    gathered ``[d_in, r]`` / ``[r, d_out]`` factors (rank ``r`` ≪ d,
+    so the extra FLOPs are a rounding error next to the base matmul).
+    """
+    ent = None if ad is None else ad.get(name)
+    if ent is None:
+        return None
+    rows = ad["rows"]
+    a = jnp.take(ent["a"][:, li], rows, axis=0)  # [B, d_in, r]
+    b = jnp.take(ent["b"][:, li], rows, axis=0)  # [B, r, d_out]
+    h = jnp.einsum("btd,bdr->btr", x.astype(a.dtype), a)
+    return jnp.einsum("btr,bro->bto", h, b).astype(x.dtype)
+
+
+def apply(ad, name: str, li: int, x, y):
+    """``y + delta`` when adapted, else ``y`` UNTOUCHED (same traced
+    graph as the base model when no overlay is present)."""
+    d = delta(ad, name, li, x)
+    return y if d is None else y + d

@@ -29,6 +29,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..tenancy.accounts import QuotaExceeded
 from ..utils import metrics, tracing
 from .policy import BATCH, CLASSES, INTERACTIVE, QueueFullError
 
@@ -81,6 +82,18 @@ class AdmissionController:
         # Flight recorder (utils/tracing.py, engine-owned): admission's
         # down-class decisions land in the engine post-mortem ring.
         self.recorder = getattr(engine, "flight", None)
+        # Per-tenant quota registry (tenancy/accounts.py; attached by
+        # the batcher when TENANTS is configured).  None = no tenant
+        # gate, bit-identical to pre-tenancy admission.
+        self.tenants = None
+
+    def set_tenants(self, registry) -> None:
+        """Attach (or detach) the shared ``TenantRegistry``: every
+        admission then charges the caller's tenant ledgers (concurrency
+        occupancy, sliding-window tokens, committed KV) and sheds with
+        reason ``quota`` → HTTP 429 + Retry-After when one is
+        exhausted."""
+        self.tenants = registry
 
     def _note_downclass(self, feats: dict, why: str) -> None:
         rid = str(feats.get("request_id") or "")
@@ -206,6 +219,11 @@ class AdmissionController:
             raise QueueFullError(
                 "server is draining", reason="drain", retry_after_s=5.0
             )
+        klass, kv = self._admit_kv(feats, klass)
+        self._quota_gate(feats, kv)
+        return klass, kv
+
+    def _admit_kv(self, feats: dict, klass: str) -> tuple[str, int]:
         if self.paged and self.pool is not None:
             initial, worst = self.engine.kv_blocks_estimate(feats)
             if worst > self.ledger_blocks():
@@ -235,6 +253,42 @@ class AdmissionController:
                 klass = BATCH
                 self._note_downclass(feats, "kv_overcommit")
         return klass, kv
+
+    # -- per-tenant quotas (tenancy/accounts.py) -----------------------
+
+    def _quota_gate(self, feats: dict, kv: int) -> None:
+        """Charge the caller's tenant ledgers and stash the lease in
+        ``feats["_lease"]`` (released via ``release_lease``).  Runs
+        LAST, after the service-wide gates: a request the service would
+        shed anyway must not burn the tenant's window.  Token cost is
+        the worst case — prompt length plus the clamped decode budget
+        (``InferenceEngine.budget_for``) — so the window meters offered
+        work, not realized luck."""
+        reg = self.tenants
+        if reg is None:
+            return
+        name = str(feats.get("tenant") or "")
+        spec = reg.spec(name)
+        if spec is None:
+            return
+        tokens = int(feats.get("length", 0) or 0)
+        bf = getattr(self.engine, "budget_for", None)
+        if bf is not None:
+            tokens += int(bf(feats))
+        try:
+            feats["_lease"] = reg.admit(spec, tokens, int(kv))
+        except QuotaExceeded as exc:
+            reg.note_shed(name, "quota")
+            raise QueueFullError(
+                str(exc), reason="quota", retry_after_s=exc.retry_after_s
+            ) from None
+
+    def release_lease(self, feats) -> None:
+        """Return a quota lease (idempotent; the lease pops off feats
+        so double calls on shed/finish race-free no-op)."""
+        lease = feats.pop("_lease", None) if isinstance(feats, dict) else None
+        if lease is not None and self.tenants is not None:
+            self.tenants.release(lease)
 
     def backfill_ok(self) -> bool:
         """Advisory pre-admission gate for bulk-job line claiming
@@ -276,6 +330,19 @@ class AdmissionController:
                 <= self.kv_budget_bytes
 
     def reserve(self, item) -> None:
+        # A stream re-entering service (preemption resume, failover
+        # adoption, journal replay) released its quota lease when it
+        # checkpointed: re-charge OCCUPANCY (concurrency + KV, never
+        # window tokens) unconditionally — started streams must not
+        # convert into quota errors (tenancy/accounts.readmit).
+        if self.tenants is not None:
+            feats = getattr(item, "feats", None)
+            if isinstance(feats, dict) and "_lease" not in feats:
+                name = str(feats.get("tenant") or "")
+                if self.tenants.spec(name) is not None:
+                    feats["_lease"] = self.tenants.readmit(
+                        name, int(getattr(item, "kv", 0))
+                    )
         if self.paged and getattr(item, "is_stream", False):
             # The pool is the ledger: blocks commit at slot insert and
             # grow at chunk boundaries (engine/streams.py); nothing to
@@ -292,6 +359,7 @@ class AdmissionController:
             item.kv_held = True
 
     def release(self, item) -> None:
+        self.release_lease(getattr(item, "feats", None))
         if self.paged and getattr(item, "is_stream", False):
             self.note_pool()
             return

@@ -60,7 +60,7 @@ class _QueuedCall:
 
     __slots__ = (
         "feats", "future", "t_in", "klass", "deadline", "started",
-        "kv", "kv_held", "_removed",
+        "kv", "kv_held", "_removed", "tenant",
     )
 
     def __init__(self, feats, future, klass, deadline, kv):
@@ -73,6 +73,9 @@ class _QueuedCall:
         self.kv = kv
         self.kv_held = False
         self._removed = False
+        # Fair-share dequeue key (tenancy/fairshare.py, via
+        # DeadlineQueue.set_fairshare); "" = anonymous.
+        self.tenant = str(feats.get("tenant") or "")
 
     def fail(self, exc: BaseException) -> None:
         if not self.future.done():
@@ -215,6 +218,69 @@ class Batcher:
             from ..jobs.executor import JobManager
 
             self.jobs = JobManager(engine, self, cfg)
+        # Multi-tenant serving platform (tenancy/;
+        # docs/multi-tenancy.md): per-tenant quotas + weighted fair
+        # share (TENANTS/TENANTS_FILE) and the batched multi-adapter
+        # LoRA pool (ADAPTER_DIR).  Both unset (default) builds NONE of
+        # it — every queue, ledger and dispatch stays bit-identical to
+        # the pre-tenancy code (pinned by test).
+        self.tenants = None
+        self.adapters = None
+        if getattr(cfg, "tenants", None) or getattr(
+            cfg, "tenants_file", None
+        ) or getattr(cfg, "adapter_dir", None):
+            from ..tenancy.accounts import TenantRegistry
+            from ..tenancy.adapters import AdapterPool
+            from ..tenancy.fairshare import WeightedFairShare
+
+            default_w = float(
+                getattr(cfg, "tenant_default_weight", 1.0) or 1.0
+            )
+            try:
+                reg = TenantRegistry.from_cfg(cfg, model=self.model)
+                pool = AdapterPool.from_cfg(cfg, model=self.model)
+                if pool is not None:
+                    if getattr(engine, "spec_enabled", False) or (
+                        self._cdl is not None
+                        and getattr(self._cdl, "spec", False)
+                    ):
+                        raise ValueError(
+                            "ADAPTER_DIR does not compose with "
+                            "SPEC_DECODE/SPEC_CONTINUOUS: the "
+                            "draft→verify executables run the base "
+                            "model only"
+                        )
+                    # Wrong-architecture adapters fail the BOOT, not
+                    # the first adapted request.
+                    pool.validate_against(engine.params)
+            except Exception:
+                # Fail-fast boot must not leak the already-started
+                # decode loop / fleet threads.
+                if self.fleet is not None:
+                    self.fleet.stop()
+                elif self._cdl is not None:
+                    self._cdl.stop()
+                raise
+            self.tenants = reg
+            self.adapters = pool
+            if reg is not None:
+                self.admission.set_tenants(reg)
+                self._queue.set_fairshare(
+                    WeightedFairShare(reg.weights(), default_w)
+                )
+            if self.fleet is not None:
+                # Shared registry (one quota ledger across replicas),
+                # per-replica fair-share cursors + adapter device
+                # stacks — applied to live replicas and every replica
+                # the governor spawns later.
+                self.fleet.set_tenancy(reg, pool, default_w)
+            elif self._cdl is not None:
+                self._cdl.tenants = reg
+                self._cdl.adapters = pool
+                if reg is not None:
+                    self._cdl.queue.set_fairshare(
+                        WeightedFairShare(reg.weights(), default_w)
+                    )
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -256,8 +322,16 @@ class Batcher:
         With the process-level ExecutableCache every replica past the
         first warms compile-free (runtime/compile_cache.py)."""
         if self.fleet is not None:
+            for rep in self.fleet.replicas:
+                ad = getattr(rep.cdl, "adapters", None)
+                if ad is not None:
+                    ad.warm()
             self.fleet.warm()
         elif self._cdl is not None:
+            if self._cdl.adapters is not None:
+                # Trace the slot installers first: serve-time adapter
+                # installs/evictions must be dispatch-only.
+                self._cdl.adapters.warm()
             self._cdl.warm()
 
     def compile_status(self) -> dict:
@@ -278,6 +352,37 @@ class Batcher:
             "xla_compiles": comp["count"],
             "xla_compile_s": round(comp["seconds"], 3),
         }
+
+    def tenancy_status(self) -> dict | None:
+        """/status.tenancy: per-tenant usage + quota envelope, the
+        fair-share virtual-time cursors, and adapter-pool residency.
+        None (tenancy off) = the key is absent from /status entirely —
+        part of the bit-identical-default contract."""
+        pools = []
+        if self.fleet is not None:
+            pools = [
+                r.cdl.adapters for r in self.fleet.replicas
+                if getattr(r.cdl, "adapters", None) is not None
+            ]
+        elif self._cdl is not None and self._cdl.adapters is not None:
+            pools = [self._cdl.adapters]
+        elif self.adapters is not None:
+            pools = [self.adapters]
+        if self.tenants is None and not pools:
+            return None
+        out: dict = {}
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.usage()
+            out["totals"] = self.tenants.totals()
+            fs = getattr(self._queue, "_fairshare", None)
+            if fs is not None:
+                out["fairshare"] = fs.snapshot()
+        if pools:
+            out["adapters"] = (
+                pools[0].status() if len(pools) == 1
+                else [p.status() for p in pools]
+            )
+        return out
 
     # ------------------------------------------------------------------
     # drain lifecycle (SIGTERM)
@@ -327,8 +432,12 @@ class Batcher:
     # ------------------------------------------------------------------
     # shed helpers
 
-    def _shed(self, reason: str) -> None:
+    def _shed(self, reason: str, tenant: str = "") -> None:
         metrics.SHED.labels(self.model, reason).inc()
+        if self.tenants is not None and reason != "quota":
+            # Per-tenant attribution (bounded label; "" → anon).  Quota
+            # sheds are already attributed at the admission gate.
+            self.tenants.note_shed(tenant, reason)
         fl = getattr(self.engine, "flight", None)
         if fl is not None:
             fl.event("shed", reason=reason, path="batch")
@@ -383,7 +492,7 @@ class Batcher:
         except QueueFullError as e:
             if e.retry_after_s is None:
                 e.retry_after_s = self.retry_after_s()
-            self._shed(e.reason)
+            self._shed(e.reason, str(feats.get("tenant") or ""))
             raise
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -391,11 +500,13 @@ class Batcher:
         try:
             victim = self._queue.put(item)
         except QueueFullError as e:
+            self.admission.release_lease(feats)
             e.retry_after_s = self.retry_after_s()
-            self._shed("queue_full")
+            self._shed("queue_full", item.tenant)
             raise
         if victim is not None:
-            self._shed("queue_full")
+            self.admission.release_lease(victim.feats)
+            self._shed("queue_full", victim.tenant)
             victim.fail(QueueFullError(
                 "shed for higher-priority work",
                 retry_after_s=self.retry_after_s(),
@@ -428,6 +539,7 @@ class Batcher:
         cdl_admitted = self._cdl._admitted if self._cdl is not None else 0
         spec_route = (
             getattr(self.engine, "spec_enabled", False)
+            and not feats.get("adapter_id")
             and (
                 float(feats.get("temperature", 0.0)) == 0.0
                 or getattr(self.engine, "spec_sampled", False)
@@ -470,8 +582,21 @@ class Batcher:
         except QueueFullError as e:
             if e.retry_after_s is None:
                 e.retry_after_s = self.retry_after_s(streams=True)
-            self._shed(e.reason)
+            self._shed(e.reason, str(feats.get("tenant") or ""))
             raise
+        if feats.get("adapter_id"):
+            # Adapters serve through the continuous loop's batched
+            # multi-adapter dispatch only; this path sheds honestly
+            # instead of silently generating base-model tokens.
+            self.admission.release_lease(feats)
+            self._shed("adapter_pool", str(feats.get("tenant") or ""))
+            raise QueueFullError(
+                "adapter streams require the continuous batching path "
+                "(prompt exceeds the largest seq bucket, or "
+                "CONTINUOUS_BATCHING=0)",
+                reason="adapter_pool",
+                retry_after_s=self.retry_after_s(streams=True),
+            )
         # Oversized prompts (longer than the largest seq bucket) cannot
         # join the shared slot batch; they keep the per-stream path —
         # but MAX_STREAMS caps TOTAL concurrent generations, so count
@@ -481,7 +606,8 @@ class Batcher:
             else self._cdl._admitted if self._cdl is not None else 0
         )
         if self._active_streams + cdl_active >= self.max_streams:
-            self._shed("queue_full")
+            self.admission.release_lease(feats)
+            self._shed("queue_full", str(feats.get("tenant") or ""))
             raise QueueFullError(
                 f"{self._active_streams} streams active >= "
                 f"max_streams={self.max_streams}",
@@ -530,6 +656,7 @@ class Batcher:
 
         def _release(_fut):
             self._active_streams -= 1
+            self.admission.release_lease(feats)
             dt = time.monotonic() - t_started
             self._stream_ewma_s = 0.8 * self._stream_ewma_s + 0.2 * dt
 
@@ -581,7 +708,8 @@ class Batcher:
         """Fail every waiter whose deadline passed — a fast 504 NOW
         beats serving stale work or a client-side timeout later."""
         for item in self._queue.expire():
-            self._shed("deadline")
+            self.admission.release(item)
+            self._shed("deadline", item.tenant)
             item.fail(DeadlineExceededError(
                 "deadline passed while queued; request shed before dispatch"
             ))

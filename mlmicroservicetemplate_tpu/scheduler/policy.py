@@ -48,8 +48,11 @@ CLASSES = (INTERACTIVE, BATCH)
 class QueueFullError(Exception):
     """Queue at capacity; shed load (HTTP 503).
 
-    ``reason`` labels the shed counter (queue_full | kv_budget | drain);
-    ``retry_after_s`` rides to the HTTP Retry-After header.
+    ``reason`` labels the shed counter (queue_full | kv_budget | drain |
+    quota | adapter_pool); ``retry_after_s`` rides to the HTTP
+    Retry-After header.  ``quota`` sheds (per-tenant admission,
+    tenancy/accounts.py) map to HTTP 429 instead of 503 — the tenant is
+    over ITS budget while the service has capacity to sell elsewhere.
     """
 
     def __init__(self, msg: str = "", reason: str = "queue_full",
@@ -519,6 +522,10 @@ class DeadlineQueue:
         self._cond = threading.Condition()
         self._seq = itertools.count()
         self._streak = 0  # consecutive interactive pops while batch waits
+        # Optional weighted fair share across tenants WITHIN a class
+        # (tenancy/fairshare.py; set by the batcher when TENANTS is
+        # configured).  None = plain EDF, bit-identical to pre-tenancy.
+        self._fairshare = None
         # Injectable clock (graftlint: clock-injection) — expiry and
         # pop timeouts pin in tests without sleeping through real
         # deadlines; item deadlines stay absolute seconds on this clock.
@@ -640,6 +647,16 @@ class DeadlineQueue:
                 if not self._cond.wait(timeout=remaining):
                     return self._pop_locked(fits)
 
+    def set_fairshare(self, fs) -> None:
+        """Attach (or detach, ``None``) a ``WeightedFairShare`` ledger:
+        dequeue becomes per-tenant EDF under weighted virtual time —
+        within each class the tenant with the lowest virtual finish time
+        is served its earliest-deadline waiter, so a heavy tenant's
+        backlog cannot starve light tenants (pinned by
+        tests/test_tenancy.py)."""
+        with self._cond:
+            self._fairshare = fs
+
     def prefer_interactive(self) -> None:
         """Reset the weighted-dequeue streak so the next pop serves the
         interactive class (used right after a preemption: the slot that
@@ -668,6 +685,8 @@ class DeadlineQueue:
         )
 
     def _pop_class_locked(self, klass: str, fits):
+        if self._fairshare is not None:
+            return self._pop_class_fair_locked(klass, fits, self._fairshare)
         heap = self._heaps[klass]
         stash = []
         found = None
@@ -688,6 +707,32 @@ class DeadlineQueue:
         for entry in stash:
             heapq.heappush(heap, entry)
         return found
+
+    def _pop_class_fair_locked(self, klass: str, fits, fs):
+        """Weighted-fair pop: per-tenant EDF head, then the fair-share
+        ledger picks which tenant is served.  O(n) scan with lazy heap
+        deletion — the heap keeps EDF order for the plain path and for
+        ``expire``; fairness only reorders ACROSS tenants, never within
+        one (EDF-within-tenant is preserved by taking each tenant's
+        heap-key minimum)."""
+        heads: dict[str, tuple] = {}
+        for key, it in self._heaps[klass]:
+            if it._removed:
+                continue
+            if fits is not None and not fits(it):
+                continue
+            t = getattr(it, "tenant", "") or ""
+            cur = heads.get(t)
+            if cur is None or key < cur[0]:
+                heads[t] = (key, it)
+        if not heads:
+            return None
+        tenant = fs.pick(heads.keys())
+        _, it = heads[tenant]
+        it._removed = True
+        self._count[klass] -= 1
+        fs.charge(tenant)
+        return it
 
     # -- expiry / shutdown --------------------------------------------
 

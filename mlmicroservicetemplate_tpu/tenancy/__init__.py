@@ -1,0 +1,30 @@
+"""Multi-tenant serving (ROADMAP item 5; docs/multi-tenancy.md).
+
+Three composable pieces, all built only when the ``TENANTS`` /
+``TENANTS_FILE`` / ``ADAPTER_DIR`` knobs are set (unset = none of this
+is constructed and serving is bit-identical to the single-tenant
+server, pinned by tests/test_tenancy.py):
+
+- ``accounts``  — API-key → tenant classification, per-tenant quota
+  ledger (concurrency / sliding-window tokens / KV bytes), per-tenant
+  SLO burn riding the r20 SLOTracker.
+- ``fairshare`` — weighted virtual-time fair queueing across tenants
+  inside one priority class of ``scheduler.policy.DeadlineQueue``.
+- ``adapters``  — N LoRA deltas over one shared base model, paged
+  through a refcounted device-slot pool and served as ONE batched
+  decode dispatch via a per-row adapter-index vector
+  (``models/lora.py``).
+"""
+
+from .accounts import QuotaExceeded, TenantRegistry, TenantSpec
+from .adapters import AdapterBusy, AdapterPool
+from .fairshare import WeightedFairShare
+
+__all__ = [
+    "AdapterBusy",
+    "AdapterPool",
+    "QuotaExceeded",
+    "TenantRegistry",
+    "TenantSpec",
+    "WeightedFairShare",
+]

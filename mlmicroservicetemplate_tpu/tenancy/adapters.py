@@ -1,0 +1,398 @@
+"""Adapter pool: N LoRA adapters paged through S stacked device slots.
+
+The kv_blocks.py discipline applied to adapter weights: host copies
+(loaded once from ``ADAPTER_DIR``) are the source of truth, a fixed
+number of device-resident slots serve live traffic, and cold slots
+demote by simple overwrite (the host copy never leaves RAM, so
+"demotion" costs nothing and "promotion" is one device install).
+
+- **Loading** — ``ADAPTER_DIR/*.npz`` (and ``*.safetensors`` when the
+  library is importable; gated, never a hard dependency), one file per
+  adapter, id = file stem.  Key convention:
+  ``layers.{li}.{proj}.lora_a`` ``[d_in, r]`` and ``.lora_b``
+  ``[r, d_out]`` per layer/projection, optional scalar ``alpha``
+  (scale ``alpha/r`` is folded into B at load — serving never
+  multiplies by it).  Ranks may differ per adapter; stacks are
+  zero-padded to the max rank (exact: padded rank columns contribute
+  nothing).
+- **Slots** — ``ADAPTER_SLOTS`` device slots plus the built-in all-zero
+  slot 0 (``adapter_id=None`` rows).  ``acquire`` refcounts a resident
+  slot or installs into a free/coldest-idle one; every slot busy =
+  :class:`AdapterBusy` (shed, retryable).  Installs go through ONE
+  jitted dynamic-slice updater with a TRACED slot index, so serving a
+  new adapter never compiles anything after warm
+  (CompileWindow-pinned).
+- **Overlay** — ``overlay(params, rows)`` attaches the stacks + the
+  per-row slot vector as ``params["__adapters__"]``
+  (``models/lora.py`` consumes it inside the jitted steps).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+import numpy as np
+
+from ..utils import metrics
+
+
+class AdapterBusy(Exception):
+    """Every adapter slot is refcounted by a live stream; shed the
+    request (503, retryable) instead of blocking the decode loop."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+def _load_file(path: str) -> dict[str, np.ndarray]:
+    """Flat name→array dict from one adapter checkpoint file."""
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    if path.endswith(".safetensors"):
+        try:
+            from safetensors.numpy import load_file
+        except Exception:
+            raise ValueError(
+                f"{path}: safetensors not importable in this runtime; "
+                "convert the adapter to .npz"
+            )
+        return dict(load_file(path))
+    raise ValueError(f"{path}: unsupported adapter format")
+
+
+def _parse_adapter(name: str, raw: dict[str, np.ndarray]) -> dict:
+    """``{proj: (A [L, d_in, r], B [L, r, d_out])}`` (scale folded into
+    B) from the flat key convention; strict — a malformed adapter file
+    fails the BOOT, not a request."""
+    alpha = float(raw.get("alpha", 0.0)) if "alpha" in raw else 0.0
+    layers: dict[str, dict[int, tuple]] = {}
+    n_layers = -1
+    for key, arr in raw.items():
+        if key in ("alpha", "r"):
+            continue
+        parts = key.split(".")
+        if (len(parts) != 4 or parts[0] != "layers"
+                or parts[3] not in ("lora_a", "lora_b")):
+            raise ValueError(
+                f"adapter {name!r}: unexpected key {key!r} (want "
+                "layers.<li>.<proj>.lora_a|lora_b)"
+            )
+        li, proj = int(parts[1]), parts[2]
+        slot = layers.setdefault(proj, {}).setdefault(li, [None, None])
+        slot[0 if parts[3] == "lora_a" else 1] = np.asarray(arr, np.float32)
+        n_layers = max(n_layers, li + 1)
+    if not layers:
+        raise ValueError(f"adapter {name!r}: no layers.* keys")
+    out = {}
+    for proj, per_layer in layers.items():
+        a_rows, b_rows = [], []
+        for li in range(n_layers):
+            ent = per_layer.get(li)
+            if ent is None or ent[0] is None or ent[1] is None:
+                raise ValueError(
+                    f"adapter {name!r}: projection {proj!r} missing "
+                    f"lora_a/lora_b at layer {li}"
+                )
+            a, b = ent
+            if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+                raise ValueError(
+                    f"adapter {name!r}: {proj!r} layer {li} rank "
+                    f"mismatch ({a.shape} vs {b.shape})"
+                )
+            r = a.shape[1]
+            scale = (alpha / r) if alpha else 1.0
+            a_rows.append(a)
+            b_rows.append(b * np.float32(scale))
+        out[proj] = (np.stack(a_rows), np.stack(b_rows))
+    return out
+
+
+def load_adapter_dir(path: str) -> dict[str, dict]:
+    """All adapters under ``path`` (sorted order → deterministic ids);
+    empty/missing directory raises — a configured ADAPTER_DIR with
+    nothing to serve is a deployment mistake."""
+    if not os.path.isdir(path):
+        raise ValueError(f"ADAPTER_DIR {path!r} is not a directory")
+    names = sorted(
+        f for f in os.listdir(path)
+        if f.endswith((".npz", ".safetensors"))
+    )
+    if not names:
+        raise ValueError(f"ADAPTER_DIR {path!r} holds no .npz/.safetensors")
+    out = {}
+    for fname in names:
+        aid = fname.rsplit(".", 1)[0]
+        out[aid] = _parse_adapter(aid, _load_file(os.path.join(path, fname)))
+    return out
+
+
+class AdapterPool:
+    """Refcounted device-slot pool over host-resident LoRA adapters.
+
+    One pool per engine (fleet replicas each hold their own device
+    stacks; the host dict is shared read-only).  Thread-safe: the
+    decode loop acquires at admission and releases at stream teardown.
+    """
+
+    def __init__(self, host: dict[str, dict], slots: int = 8,
+                 model: str = ""):
+        if not host:
+            raise ValueError("AdapterPool needs at least one adapter")
+        self.model = model
+        self.host = dict(host)
+        self.n_slots = max(1, int(slots))
+        first = next(iter(host.values()))
+        self.projections = tuple(sorted(first))
+        self.num_layers = first[self.projections[0]][0].shape[0]
+        self.rank = 0
+        for ad in host.values():
+            if tuple(sorted(ad)) != self.projections:
+                raise ValueError(
+                    "adapters disagree on projection set "
+                    f"({tuple(sorted(ad))} vs {self.projections})"
+                )
+            for proj, (a, b) in ad.items():
+                if a.shape[0] != self.num_layers:
+                    raise ValueError(
+                        f"adapters disagree on layer count for {proj!r}"
+                    )
+                self.rank = max(self.rank, a.shape[2])
+        self._lock = threading.Lock()
+        # slot index (1-based; 0 is the permanent zero adapter) →
+        # adapter id, refcount, lru tick.
+        self._slot_of: dict[str, int] = {}
+        self._aid_at: dict[int, str] = {}
+        self._refs: dict[int, int] = {}
+        self._tick = 0
+        self._lru: dict[int, int] = {}
+        self.installs = 0
+        self.demotions = 0
+        self._stacks: dict[str, dict[str, Any]] = {}
+        self._install_fn = None
+        self._rows_cache: dict[int, Any] = {}
+        self._build_stacks()
+        self._note_gauges()
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_cfg(cls, cfg, model: str = ""):
+        """Pool from ``ADAPTER_DIR``/``ADAPTER_SLOTS``, or None when
+        the knob is unset (bit-identical default, pinned)."""
+        path = getattr(cfg, "adapter_dir", None)
+        if not path:
+            return None
+        return cls(
+            load_adapter_dir(path),
+            slots=int(getattr(cfg, "adapter_slots", 8) or 8),
+            model=model,
+        )
+
+    def _build_stacks(self) -> None:
+        import jax.numpy as jnp
+
+        s = self.n_slots + 1
+        ref = next(iter(self.host.values()))
+        for proj in self.projections:
+            a, b = ref[proj]
+            d_in, d_out = a.shape[1], b.shape[2]
+            self._stacks[proj] = {
+                "a": jnp.zeros((s, self.num_layers, d_in, self.rank),
+                               jnp.float32),
+                "b": jnp.zeros((s, self.num_layers, self.rank, d_out),
+                               jnp.float32),
+            }
+
+    def _padded(self, arr: np.ndarray, axis: int) -> np.ndarray:
+        """Zero-pad the rank axis to the pool's max rank (exact: the
+        padded factor columns multiply to nothing)."""
+        if arr.shape[axis] == self.rank:
+            return arr
+        pad = [(0, 0)] * arr.ndim
+        pad[axis] = (0, self.rank - arr.shape[axis])
+        return np.pad(arr, pad)
+
+    def _installer(self):
+        """ONE jitted updater with a TRACED slot index, shared by every
+        install — adapter loads after warm never compile (pinned)."""
+        if self._install_fn is None:
+            import jax
+            from jax import lax
+
+            self._install_fn = jax.jit(
+                lambda stack, arr, slot: lax.dynamic_update_slice_in_dim(
+                    stack, arr[None], slot, axis=0
+                )
+            )
+        return self._install_fn
+
+    def _install_locked(self, aid: str, slot: int) -> None:
+        import jax.numpy as jnp
+
+        ins = self._installer()
+        old = self._aid_at.pop(slot, None)
+        if old is not None:
+            self._slot_of.pop(old, None)
+            self.demotions += 1
+        for proj, (a, b) in self.host[aid].items():
+            st = self._stacks[proj]
+            st["a"] = ins(st["a"], jnp.asarray(self._padded(a, 2)),
+                          jnp.int32(slot))
+            st["b"] = ins(st["b"], jnp.asarray(self._padded(b, 1)),
+                          jnp.int32(slot))
+        self._slot_of[aid] = slot
+        self._aid_at[slot] = aid
+        self.installs += 1
+
+    def warm(self) -> None:
+        """Trace the installer for every stack shape by re-writing slot
+        0's zero delta (a semantic no-op), so serve-time installs are
+        dispatch-only."""
+        import jax.numpy as jnp
+
+        ins = self._installer()
+        with self._lock:
+            for st in self._stacks.values():
+                st["a"] = ins(st["a"],
+                              jnp.zeros(st["a"].shape[1:], jnp.float32),
+                              jnp.int32(0))
+                st["b"] = ins(st["b"],
+                              jnp.zeros(st["b"].shape[1:], jnp.float32),
+                              jnp.int32(0))
+
+    # -- serving --------------------------------------------------------
+
+    def known(self, aid: str) -> bool:
+        return aid in self.host
+
+    def ids(self) -> list[str]:
+        return sorted(self.host)
+
+    def acquire(self, aid: str) -> int:
+        """Slot serving ``aid`` with one reference taken; installs into
+        a free or coldest-idle slot when not resident."""
+        if aid not in self.host:
+            raise KeyError(f"unknown adapter {aid!r}")
+        with self._lock:
+            self._tick += 1
+            slot = self._slot_of.get(aid)
+            if slot is None:
+                slot = self._find_slot_locked()
+                if slot is None:
+                    raise AdapterBusy(
+                        f"all {self.n_slots} adapter slots are serving "
+                        "live streams"
+                    )
+                self._install_locked(aid, slot)
+            self._refs[slot] = self._refs.get(slot, 0) + 1
+            self._lru[slot] = self._tick
+        self._note_gauges()
+        return slot
+
+    def _find_slot_locked(self) -> int | None:
+        for slot in range(1, self.n_slots + 1):
+            if slot not in self._aid_at:
+                return slot
+        idle = [s for s in range(1, self.n_slots + 1)
+                if not self._refs.get(s)]
+        if not idle:
+            return None
+        return min(idle, key=lambda s: self._lru.get(s, 0))
+
+    def release(self, slot: int) -> None:
+        """Drop one reference on ``slot`` (slot 0 / non-positive = the
+        zero adapter, never refcounted)."""
+        if slot <= 0:
+            return
+        with self._lock:
+            self._refs[slot] = max(0, self._refs.get(slot, 0) - 1)
+        self._note_gauges()
+
+    def overlay(self, params: dict, rows) -> dict:
+        """``params`` plus the ``__adapters__`` overlay for one
+        dispatch whose row ``i`` runs adapter slot ``rows[i]``."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            ad: dict[str, Any] = {
+                proj: dict(st) for proj, st in self._stacks.items()
+            }
+        rows = np.asarray(rows, np.int32)
+        if rows.size and not rows.any():
+            # All-base dispatches (warm, empty-state builds) reuse one
+            # cached device zeros vector per batch size.
+            cached = self._rows_cache.get(rows.size)
+            if cached is None:
+                cached = jnp.zeros((rows.size,), jnp.int32)
+                self._rows_cache[rows.size] = cached
+            ad["rows"] = cached
+        else:
+            ad["rows"] = jnp.asarray(rows)
+        p = dict(params)
+        p["__adapters__"] = ad
+        return p
+
+    # -- observability --------------------------------------------------
+
+    def _note_gauges(self) -> None:
+        with self._lock:
+            resident = len(self._aid_at)
+            active = sum(1 for s, r in self._refs.items() if r > 0)
+            free = self.n_slots - resident
+        g = metrics.ADAPTER_SLOTS.labels
+        g(self.model, "resident").set(resident)
+        g(self.model, "active").set(active)
+        g(self.model, "free").set(free)
+        g(self.model, "host").set(len(self.host))
+
+    def status(self) -> dict:
+        """/status.tenancy.adapters: residency + lifetime counters."""
+        with self._lock:
+            residents = {
+                str(slot): {
+                    "adapter": aid,
+                    "refs": self._refs.get(slot, 0),
+                }
+                for slot, aid in sorted(self._aid_at.items())
+            }
+            return {
+                "slots": self.n_slots,
+                "host_adapters": len(self.host),
+                "resident": residents,
+                "installs": self.installs,
+                "demotions": self.demotions,
+                "live_refs": sum(r for r in self._refs.values() if r > 0),
+            }
+
+    def validate_against(self, params: dict) -> None:
+        """Boot-time shape check against the served model's params —
+        a wrong-architecture ADAPTER_DIR must fail startup, not the
+        first adapted request."""
+        layers = params.get("layers") if isinstance(params, dict) else None
+        if not layers:
+            raise ValueError("adapter validation: model has no layers")
+        attn = layers[0].get("attn", {})
+        for proj in self.projections:
+            tgt = attn.get(proj)
+            kernel = tgt.get("kernel") if isinstance(tgt, dict) else None
+            if kernel is None:
+                raise ValueError(
+                    f"adapters target projection {proj!r} but the model's "
+                    f"attention block has {sorted(attn)}"
+                )
+            st = self._stacks[proj]
+            d_in, d_out = st["a"].shape[2], st["b"].shape[3]
+            if tuple(kernel.shape) != (d_in, d_out):
+                raise ValueError(
+                    f"adapter projection {proj!r} is [{d_in}, {d_out}] "
+                    f"but the model kernel is {tuple(kernel.shape)}"
+                )
+        if len(layers) != self.num_layers:
+            raise ValueError(
+                f"adapters cover {self.num_layers} layers but the model "
+                f"has {len(layers)}"
+            )

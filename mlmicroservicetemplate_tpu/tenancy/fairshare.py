@@ -1,0 +1,82 @@
+"""Weighted fair share across tenants (docs/multi-tenancy.md).
+
+Virtual-time fair queueing (the start-time fair queueing family,
+SFQ/WF²Q): each tenant carries a virtual finish time ``v[t]``; serving
+one unit of work advances it by ``cost / weight(t)``, so a tenant with
+weight 3 accrues virtual time a third as fast and is picked three times
+as often under sustained contention.  ``pick`` chooses the ELIGIBLE
+tenant with the smallest ``max(v[t], vnow)`` — the ``max`` with the
+global virtual clock is the re-activation floor: a tenant that idled
+for an hour re-enters at *now*, not at its stale (tiny) virtual time,
+so idleness banks no credit and cannot be weaponized into a burst that
+starves everyone else.
+
+Pure policy, no clocks, no metrics: ``DeadlineQueue`` calls
+``pick``/``charge`` under its own condition lock, and the weighted
+3:1 / starvation behavior is pinned by tests/test_tenancy.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+
+class WeightedFairShare:
+    """Virtual-time weighted fair queueing over tenant names.
+
+    Unknown tenants (including the anonymous ``""`` tenant) get
+    ``default_weight``.  Thread-safe; state is O(tenants-ever-seen)
+    floats.
+    """
+
+    def __init__(self, weights: dict[str, float] | None = None,
+                 default_weight: float = 1.0):
+        self._weights = {
+            str(k): float(v) for k, v in (weights or {}).items() if v and v > 0
+        }
+        self._default = max(1e-9, float(default_weight))
+        self._v: dict[str, float] = {}
+        self._vnow = 0.0
+        self._served: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(str(tenant), self._default)
+
+    def pick(self, eligible: Iterable[str]) -> str | None:
+        """The eligible tenant that should be served next (None when
+        ``eligible`` is empty).  Ties break by name for determinism."""
+        with self._lock:
+            best = None
+            best_key = None
+            for t in eligible:
+                t = str(t)
+                key = (max(self._v.get(t, 0.0), self._vnow), t)
+                if best_key is None or key < best_key:
+                    best, best_key = t, key
+            return best
+
+    def charge(self, tenant: str, cost: float = 1.0) -> None:
+        """Account one served unit of work against ``tenant``."""
+        t = str(tenant)
+        with self._lock:
+            start = max(self._v.get(t, 0.0), self._vnow)
+            self._v[t] = start + float(cost) / self.weight(t)
+            # The global virtual clock tracks the LAST service start so
+            # re-activating tenants join at the present.
+            self._vnow = start
+            self._served[t] = self._served.get(t, 0) + 1
+
+    def snapshot(self) -> dict:
+        """/status.tenancy view: per-tenant weight / virtual time /
+        served count."""
+        with self._lock:
+            return {
+                t: {
+                    "weight": self.weight(t),
+                    "vtime": round(self._v.get(t, 0.0), 6),
+                    "served": self._served.get(t, 0),
+                }
+                for t in sorted(set(self._v) | set(self._weights))
+            }

@@ -304,6 +304,45 @@ class ServiceConfig(BaseModel):
     # Seconds a completed/cancelled job's results stay fetchable
     # before the store purges them; 0 = keep forever.
     job_result_ttl_s: float = 3600.0
+    # Multi-tenant serving (tenancy/; docs/multi-tenancy.md).  Inline
+    # tenant table: comma-separated "name=weight" (or bare "name",
+    # weight 1) — each tenant's name doubles as its X-Api-Key.  Unset
+    # AND TENANTS_FILE/ADAPTER_DIR unset (default) = no tenancy object
+    # is constructed anywhere and every serving path is bit-identical
+    # to the single-tenant server (pinned by tests/test_tenancy.py).
+    tenants: str | None = None
+    # Full tenant table: JSON file of spec objects with optional
+    # "weight", "api_keys", "max_concurrency", "tokens_per_window",
+    # "kv_mb" and "adapter" fields.  Both set = file wins for
+    # duplicate names.  Garbage fails at boot, not request time.
+    tenants_file: str | None = None
+    # Fair-share weight for tenants without an explicit weight (and
+    # for anonymous/keyless traffic).
+    tenant_default_weight: float = 1.0
+    # Sliding window in seconds for the per-tenant token-rate ledger
+    # (tokens_per_window quotas count tokens admitted in the trailing
+    # window; Retry-After = time until enough of the window drains).
+    tenant_window_s: float = 60.0
+    # Metric-label cardinality bound: the first K configured tenants
+    # (declaration order) keep their names in the `tenant` label,
+    # everything else exports as "other", keyless traffic as "anon" —
+    # <= K+2 label values regardless of tenant-table size.
+    tenant_metrics_topk: int = 8
+    # LoRA adapter library directory: each <name>.npz under it (keys
+    # "layers.{i}.{proj}.lora_a|lora_b", optional scalar "alpha")
+    # becomes an adapter servable via the X-Adapter header — N tenants'
+    # adapters decode as ONE batched dispatch over the shared base
+    # weights (models/lora.py), routed through the SAME executables as
+    # the base model (adapter install/evict never recompiles; pinned).
+    # Unset (default) = no adapter code runs.  Rejected with
+    # SPEC_DECODE/SPEC_CONTINUOUS (spec scoreboards assume base-model
+    # logits).
+    adapter_dir: str | None = None
+    # Device-resident adapter slots (slot 0 is the pinned zero delta
+    # serving base-model rows).  Adapters page host<->device through a
+    # refcounted pool of this many slots; acquisition beyond capacity
+    # sheds with reason="adapter_pool".
+    adapter_slots: int = 8
     # Chunked prefill with prefill–decode interleaving
     # (docs/chunked-prefill.md): prompts longer than PREFILL_CHUNK
     # tokens prefill in PREFILL_CHUNK-token windows interleaved with
@@ -527,25 +566,25 @@ class ServiceConfig(BaseModel):
 
     @field_validator("max_queue", "pipeline_depth", "max_decode_len",
                      "stream_chunk_tokens", "max_streams",
-                     "register_max_tries", "spec_max_streams")
+                     "register_max_tries")
     @classmethod
     def _check_pos_int(cls, v: int) -> int:
         if v < 1:
             raise ValueError(
                 "MAX_QUEUE/PIPELINE_DEPTH/MAX_DECODE_LEN/"
-                "STREAM_CHUNK_TOKENS/MAX_STREAMS/REGISTER_MAX_TRIES/"
-                "SPEC_MAX_STREAMS must be >= 1"
+                "STREAM_CHUNK_TOKENS/MAX_STREAMS/REGISTER_MAX_TRIES "
+                "must be >= 1"
             )
         return v
 
     @field_validator("replicas", "sp", "tp", "stream_pipeline",
-                     "max_stream_queue", "fault_seed")
+                     "max_stream_queue", "fault_seed", "spec_max_streams")
     @classmethod
     def _check_nonneg_knob_int(cls, v: int) -> int:
         if v < 0:
             raise ValueError(
                 "REPLICAS/SP/TP/STREAM_PIPELINE/MAX_STREAM_QUEUE/"
-                "FAULT_SEED must be >= 0 (0 = auto/off)"
+                "FAULT_SEED/SPEC_MAX_STREAMS must be >= 0 (0 = auto/off)"
             )
         return v
 
@@ -749,6 +788,40 @@ class ServiceConfig(BaseModel):
         if v < 0:
             raise ValueError("JOB_RESULT_TTL_S must be >= 0")
         return v
+
+    @field_validator("tenant_default_weight", "tenant_window_s")
+    @classmethod
+    def _check_tenant_pos_float(cls, v: float) -> float:
+        if v <= 0:
+            raise ValueError(
+                "TENANT_DEFAULT_WEIGHT/TENANT_WINDOW_S must be > 0"
+            )
+        return v
+
+    @field_validator("tenant_metrics_topk")
+    @classmethod
+    def _check_tenant_topk(cls, v: int) -> int:
+        if not (1 <= v <= 64):
+            raise ValueError("TENANT_METRICS_TOPK must be in [1, 64]")
+        return v
+
+    @field_validator("adapter_slots")
+    @classmethod
+    def _check_adapter_slots(cls, v: int) -> int:
+        if not (1 <= v <= 256):
+            raise ValueError("ADAPTER_SLOTS must be in [1, 256]")
+        return v
+
+    @model_validator(mode="after")
+    def _check_tenant_table(self):
+        # Boot-validate the tenant table so garbage TENANTS /
+        # TENANTS_FILE fails here, not as request-time surprises.
+        # Lazy import: tenancy is jax-free but pulls numpy/metrics.
+        if self.tenants or self.tenants_file:
+            from ..tenancy.accounts import parse_tenants
+
+            parse_tenants(self.tenants, self.tenants_file)
+        return self
 
     @field_validator("kv_prefetch_blocks")
     @classmethod
@@ -954,7 +1027,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       DRAIN_GRACE_S, PAGED_KV, KV_BLOCK_SIZE, KV_HOST_BUDGET_MB,
       KV_DISK_BUDGET_MB, JOURNAL_DIR, JOURNAL_FSYNC,
       KV_PREFETCH_BLOCKS, JOBS_ENABLED, JOB_MAX_CONCURRENT_LINES,
-      JOB_RESULT_TTL_S, PREFILL_CHUNK,
+      JOB_RESULT_TTL_S, TENANTS, TENANTS_FILE, TENANT_DEFAULT_WEIGHT,
+      TENANT_WINDOW_S, TENANT_METRICS_TOPK, ADAPTER_DIR,
+      ADAPTER_SLOTS, PREFILL_CHUNK,
       PREFILL_BUDGET, PREFILL_MAX_PROMPT, DECODE_WINDOW,
       DECODE_WINDOW_AUTO, FAULT_SPEC, FAULT_SEED,
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
@@ -996,6 +1071,9 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "profile_dir": "PROFILE_DIR",
         "journal_dir": "JOURNAL_DIR",
         "journal_fsync": "JOURNAL_FSYNC",
+        "tenants": "TENANTS",
+        "tenants_file": "TENANTS_FILE",
+        "adapter_dir": "ADAPTER_DIR",
         "compile_cache_dir": "COMPILE_CACHE_DIR",
         "latency_buckets": "LATENCY_BUCKETS",
         "slo_windows_s": "SLO_WINDOWS_S",
@@ -1024,6 +1102,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "kv_block_size": "KV_BLOCK_SIZE",
         "kv_prefetch_blocks": "KV_PREFETCH_BLOCKS",
         "job_max_concurrent_lines": "JOB_MAX_CONCURRENT_LINES",
+        "tenant_metrics_topk": "TENANT_METRICS_TOPK",
+        "adapter_slots": "ADAPTER_SLOTS",
         "prefill_chunk": "PREFILL_CHUNK",
         "prefill_budget": "PREFILL_BUDGET",
         "prefill_max_prompt": "PREFILL_MAX_PROMPT",
@@ -1056,6 +1136,8 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         ("kv_host_budget_mb", "KV_HOST_BUDGET_MB"),
         ("kv_disk_budget_mb", "KV_DISK_BUDGET_MB"),
         ("job_result_ttl_s", "JOB_RESULT_TTL_S"),
+        ("tenant_default_weight", "TENANT_DEFAULT_WEIGHT"),
+        ("tenant_window_s", "TENANT_WINDOW_S"),
         ("drain_grace_s", "DRAIN_GRACE_S"),
         ("dispatch_timeout_s", "DISPATCH_TIMEOUT_S"),
         ("dispatch_backoff_s", "DISPATCH_BACKOFF_S"),

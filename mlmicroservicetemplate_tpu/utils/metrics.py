@@ -128,7 +128,7 @@ SHED = Counter(
     "requests_shed_total",
     "Load-shed requests by reason "
     "(queue_full | deadline | kv_budget | drain | degraded | "
-    "fleet_down)",
+    "fleet_down | quota | adapter_pool)",
     ["model", "reason"],
 )
 TTFT = Histogram(
@@ -444,6 +444,46 @@ SLO_TTFT_BURN = Gauge(
     "1.0 = burning exactly at budget, >1 = violating "
     "(scheduler/policy.SLOTracker; SLO_TTFT_MS knobs)",
     ["model", "klass", "window"],
+)
+# -- multi-tenancy (tenancy/; docs/multi-tenancy.md).  The tenant
+# label is BOUNDED: the first TENANT_METRICS_TOPK configured tenants
+# export by name, everything else folds into "other" and anonymous
+# traffic into "anon" (TenantRegistry.label) — cardinality is
+# topk+2 regardless of how many API keys exist.
+TENANT_SHED = Counter(
+    "tenant_requests_shed_total",
+    "Per-tenant load sheds by reason (quota = the tenant exhausted its "
+    "own concurrency/token-window/KV envelope → HTTP 429; other "
+    "reasons mirror requests_shed_total, attributed to the caller)",
+    ["model", "tenant", "reason"],
+)
+TENANT_KV = Gauge(
+    "tenant_kv_committed_bytes",
+    "KV-cache bytes currently leased against each tenant's quota "
+    "(tenancy/accounts.py occupancy ledger; drains to zero at idle)",
+    ["model", "tenant"],
+)
+TENANT_TOKENS = Counter(
+    "tenant_tokens_total",
+    "Offered tokens charged to each tenant's sliding window (prompt "
+    "length + clamped decode budget, charged at admission — metered "
+    "work, not realized luck)",
+    ["model", "tenant"],
+)
+TENANT_SLO_BURN = Gauge(
+    "tenant_slo_ttft_burn_rate",
+    "Per-tenant TTFT SLO burn rate by window (fast/slow), same budget "
+    "arithmetic as slo_ttft_burn_rate — the noisy-neighbor blast-"
+    "radius gauge fair share is supposed to keep flat",
+    ["model", "tenant", "window"],
+)
+ADAPTER_SLOTS = Gauge(
+    "adapter_pool_slots",
+    "LoRA adapter device-slot pool by state (resident = installed "
+    "adapters, active = slots refcounted by live streams, free = "
+    "installable without eviction, host = adapters loaded host-side) "
+    "— tenancy/adapters.py",
+    ["model", "state"],
 )
 SLO_TBT_BURN = Gauge(
     "slo_tbt_burn_rate",

@@ -239,6 +239,24 @@ else
     echo "== observability smoke skipped (OBS_SMOKE=0) =="
 fi
 
+# Multi-tenant smoke (docs/multi-tenancy.md): two tenants (3:1
+# weights, one on a LoRA adapter, max_concurrency=2) over an R=2
+# fleet with a replica-0 fatal chunk fault mid-decode.  Quota sheds
+# must stay 429-classed with Retry-After through the chaos, BOTH
+# tenants must keep completing on the survivor, and every ledger —
+# tenant occupancy, adapter-pool refs, both replicas' paged-KV block
+# pools — must drain to zero (chaos tier, so it stays out of
+# tier-1).  TENANT_SMOKE=0 skips.
+if [ "${TENANT_SMOKE:-1}" != "0" ]; then
+    echo "== multi-tenant smoke (quota 429 + r0:chunk:fatal@2, LOCKTRACE=1) =="
+    timeout -k 10 240 env JAX_PLATFORMS=cpu LOCKTRACE=1 \
+        TENANT_SMOKE_SPEC="${TENANT_SMOKE_SPEC:-r0:chunk:fatal@2}" \
+        python -m pytest tests/test_tenancy.py::test_tenant_smoke_chaos \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== multi-tenant smoke skipped (TENANT_SMOKE=0) =="
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

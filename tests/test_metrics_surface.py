@@ -263,3 +263,43 @@ def test_job_series_present_after_bulk_smoke(tmp_path):
         if ln.startswith('job_lines_total{model="gpt2",state="completed"}')
     ]
     assert line_samples and float(line_samples[0].rsplit(" ", 1)[1]) >= 2
+
+
+def test_tenant_series_bounded_topk_plus_other_and_anon():
+    """Multi-tenancy observability (ISSUE 17 satellite): the ``tenant``
+    label is BOUNDED — the first TENANT_METRICS_TOPK configured tenants
+    keep their names, everything past the cap exports as ``other`` and
+    keyless traffic as ``anon`` — and every tenancy family declares at
+    most 3 labels (the repo-wide cardinality discipline)."""
+    if not metrics.HAVE_PROM:
+        pytest.skip("prometheus_client not installed")
+    from mlmicroservicetemplate_tpu.tenancy.accounts import (
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    for fam in (metrics.TENANT_SHED, metrics.TENANT_KV,
+                metrics.TENANT_TOKENS, metrics.TENANT_SLO_BURN,
+                metrics.ADAPTER_SLOTS):
+        assert len(fam._labelnames) <= 3, fam._name
+
+    specs = [TenantSpec(name=f"t{i:02d}", api_keys=(f"k{i}",))
+             for i in range(12)]
+    reg = TenantRegistry(specs, model="bound-check", topk=2)
+    for s in specs:
+        reg.note_shed(s.name, "queue_full")
+        lease = reg.admit(s, tokens=5, kv_bytes=64)
+        reg.release(lease)
+    reg.note_shed("", "deadline")  # keyless traffic
+
+    text = _scrape_body()
+    values = set()
+    for line in text.splitlines():
+        if line.startswith("tenant_requests_shed_total{") and (
+            'model="bound-check"' in line
+        ):
+            labels = line.split("{", 1)[1].split("}", 1)[0]
+            for kv in labels.split(","):
+                if kv.startswith("tenant="):
+                    values.add(kv.split("=", 1)[1].strip('"'))
+    assert values == {"t00", "t01", "other", "anon"}, values
