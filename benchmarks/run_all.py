@@ -250,6 +250,17 @@ async def main() -> None:
             check=False,
         )
 
+    # Tensor-parallel decode scaling (round-23 tentpole): TP∈{1,2} ×
+    # {dense,int8-KV} decode-step time through the production TP
+    # placement path (docs/tensor-parallel.md).  On CPU the virtual
+    # devices share one core — record the honest negative; the
+    # throughput claim is the relay-TPU run's.  TP_AB=0 skips.
+    if os.environ.get("TP_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "tp_scaling_ab.py")],
+            check=False,
+        )
+
 
 if __name__ == "__main__":
     asyncio.run(main())

@@ -128,6 +128,28 @@ class InferenceEngine:
         else:
             self.params = self.replicas.place_params(bundle.params)
             self.params_source = "host"
+        # TP observability: probe the serving mesh's collective cost
+        # once at warm (tp_collective_seconds{op}) — a step change in
+        # the gauge flags ICI vs host-hop placement drift.  Skipped
+        # when warmup is off so tiny test engines stay cheap.
+        if (getattr(self.replicas, "tp_width", 1) > 1
+                and getattr(cfg, "warmup", True)):
+            try:
+                from ..parallel.tpserve import collective_probe
+
+                d_model = int(
+                    getattr(bundle.cfg, "d_model", 0)
+                    or getattr(bundle.cfg, "hidden_size", 0) or 256
+                )
+                probe = collective_probe(self.replicas.mesh, d_model)
+                for op, sec in probe.items():
+                    metrics.TP_COLLECTIVE_SECONDS.labels(
+                        bundle.name, op
+                    ).set(sec)
+            except Exception:
+                # Observability only — never blocks boot, but a silent
+                # pass here once hid a probe bug for a whole round.
+                log.warning("TP collective probe failed", exc_info=True)
         self.batch_buckets = tuple(sorted(cfg.batch_buckets))
         self.seq_buckets = tuple(sorted(cfg.seq_buckets))
         # Decode budget rounded up to a whole number of stream chunks so

@@ -2881,9 +2881,17 @@ class ContinuousDecodeLoop:
         # and jit keys executables on sharding — every (empty-state ×
         # prefill-state) insert pair would then recompile on the first
         # real admission (measured ~1-8 s through the relay) because
-        # warm() only ever saw NamedSharding-carrying states.
-        # graftlint: unguarded(pure placement of a host-built zero template with explicit sharding; retry-safe but carries no compute — a lost device surfaces at the next guarded dispatch)
-        self._state = jax.device_put(empty, eng.replicas.batch_sharding)
+        # warm() only ever saw NamedSharding-carrying states.  Under a
+        # TP placement the KV-cache leaves additionally commit with
+        # their heads axis sharded over 'tp' (place_decode_state) —
+        # the layout sharding propagation gives prefill outputs, so
+        # insert pairs see matching shardings and nothing reshards.
+        place = getattr(eng.replicas, "place_decode_state", None)
+        if place is not None:
+            self._state = place(empty)
+        else:
+            # graftlint: unguarded(pure placement of a host-built zero template with explicit sharding; retry-safe but carries no compute — a lost device surfaces at the next guarded dispatch)
+            self._state = jax.device_put(empty, eng.replicas.batch_sharding)
         # graftlint: unguarded(same placement barrier as the device_put above)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
 
@@ -2945,8 +2953,16 @@ class ContinuousDecodeLoop:
                 template.sample,
             ),
         )
-        # graftlint: unguarded(pure placement of a host-built zero template with explicit sharding; retry-safe but carries no compute — a lost device surfaces at the next guarded dispatch)
-        self._state = jax.device_put(empty, eng.replicas.batch_sharding)
+        # Pool leaves commit sharded over 'tp' on the heads axis under
+        # a TP placement (one logical pool, per-shard buffers — block
+        # ids and the ledger stay device-agnostic); everything else
+        # keeps the slot sharding.
+        place = getattr(eng.replicas, "place_decode_state", None)
+        if place is not None:
+            self._state = place(empty, paged=True)
+        else:
+            # graftlint: unguarded(pure placement of a host-built zero template with explicit sharding; retry-safe but carries no compute — a lost device surfaces at the next guarded dispatch)
+            self._state = jax.device_put(empty, eng.replicas.batch_sharding)
         # graftlint: unguarded(same placement barrier as the device_put above)
         jax.block_until_ready(jax.tree.leaves(self._state)[0])
         # Host tier buffers build once the pool leaf shapes are known.

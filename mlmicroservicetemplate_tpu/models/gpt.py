@@ -74,6 +74,11 @@ class GPTConfig:
     # autotuner tuning table at trace time.
     pallas_variant: str = ""
     pallas_interpret: bool = False
+    # Tensor-parallel width of the serving placement (registry sets it
+    # from the TP knob; 1 = default, builds no mesh anywhere).  Static
+    # so kernel call sites decide shard_map wrapping at trace time and
+    # the autotuner keys TP entries apart (parallel/tpserve.py).
+    tp: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -505,11 +510,11 @@ def _paged_decode_step(
             vkey = cfg.pallas_variant or autotune.lookup(
                 "paged_decode", b=b, kvh=ck.shape[2], n_rep=1,
                 d=q.shape[3], block_size=bs, t=table.shape[1],
-                dtype=str(q.dtype), quant=False,
+                dtype=str(q.dtype), quant=False, tp=cfg.tp,
             )
             ctx = paged_decode_attention(
                 q[:, 0], ck, cv, table, key_valid, bs,
-                interpret=cfg.pallas_interpret, variant=vkey,
+                interpret=cfg.pallas_interpret, variant=vkey, tp=cfg.tp,
             )[:, None]
         else:
             kd = gather_pages(ck, table, bs)

@@ -83,6 +83,11 @@ class LlamaConfig:
     # softmax like the jnp path (verified equal in tests/test_ops.py);
     # serving-only, no VJP.
     pallas_decode: bool = False
+    # Tensor-parallel width of the serving placement (registry sets it
+    # from the TP knob; 1 = default, builds no mesh anywhere).  Static
+    # so kernel call sites decide shard_map wrapping at trace time and
+    # the autotuner keys TP entries apart (parallel/tpserve.py).
+    tp: int = 1
     # Kernel-variant pin (ops/paged_attention.Variant grammar, e.g.
     # "b4-hb"): "" = resolve through the autotuner's tuning table at
     # trace time (ops/autotune.lookup — the measured winner for this
@@ -422,16 +427,17 @@ def _cache_attention(cfg: LlamaConfig, q, ck, cv, mask):
             "decode", b=q.shape[0], kvh=kslab.shape[2],
             n_rep=q.shape[2] // kslab.shape[2], d=q.shape[3],
             block_size=0, t=kslab.shape[1], dtype=str(q.dtype), quant=quant,
+            tp=cfg.tp,
         )
         if quant:
             ctx = decode_attention(
                 q[:, 0], ck[0], cv[0], m2, k_scale=ck[1], v_scale=cv[1],
-                interpret=cfg.pallas_interpret, variant=vkey,
+                interpret=cfg.pallas_interpret, variant=vkey, tp=cfg.tp,
             )
         else:
             ctx = decode_attention(q[:, 0], ck, cv, m2,
                                    interpret=cfg.pallas_interpret,
-                                   variant=vkey)
+                                   variant=vkey, tp=cfg.tp)
         return ctx[:, None]  # [B, 1, H, D]
     if isinstance(ck, tuple):
         return mha_attention_kv8(
@@ -630,17 +636,18 @@ def _paged_cache_attention(cfg: LlamaConfig, q, ck, cv, table, key_valid,
             "paged_decode", b=q.shape[0], kvh=kpool.shape[2],
             n_rep=q.shape[2] // kpool.shape[2], d=q.shape[3],
             block_size=bs, t=table.shape[1], dtype=str(q.dtype), quant=quant,
+            tp=cfg.tp,
         )
         if quant:
             ctx = paged_decode_attention(
                 q[:, 0], ck[0], cv[0], table, key_valid, bs,
                 k_scale=ck[1], v_scale=cv[1],
-                interpret=cfg.pallas_interpret, variant=vkey,
+                interpret=cfg.pallas_interpret, variant=vkey, tp=cfg.tp,
             )
         else:
             ctx = paged_decode_attention(q[:, 0], ck, cv, table, key_valid,
                                          bs, interpret=cfg.pallas_interpret,
-                                         variant=vkey)
+                                         variant=vkey, tp=cfg.tp)
         return ctx[:, None]
     from ..ops.paged_attention import gather_pages
 

@@ -289,6 +289,14 @@ def _pallas_knobs(svc_cfg) -> dict:
 
         parse_variant(pin)
         out["pallas_variant"] = pin
+    # TP width rides in the frozen model config too: kernel call sites
+    # are pure functions that decide shard_map wrapping at trace time,
+    # and the autotuner keys TP entries apart.  TP<=1 sets nothing —
+    # the config (and every executable keyed on it) stays bit-identical
+    # to pre-TP builds.
+    tp = int(getattr(svc_cfg, "tp", 0) or 0)
+    if tp > 1:
+        out["tp"] = tp
     return out
 
 
@@ -321,11 +329,26 @@ def _tp_placement(svc_cfg, model_cfg, family: str):
             "{'q8','scale'} subtrees the TP param spec cannot shard); "
             "pick one"
         )
+    heads = int(getattr(model_cfg, "num_heads", 0) or 0)
+    kvh = int(getattr(model_cfg, "num_kv_heads", heads) or heads)
+    if heads and (heads % tp or kvh % tp):
+        raise ValueError(
+            f"TP={tp} must divide attention heads evenly "
+            f"(num_heads={heads}, kv_heads={kvh}): q/k/v shards and the "
+            "KV cache's heads axis split over the 'tp' mesh axis"
+        )
     from ..parallel import TensorParallelSet, make_replica_tp_mesh
     from ..parallel.tp import PARAM_SPECS
 
     spec = PARAM_SPECS[family](model_cfg)
-    mesh = make_replica_tp_mesh(tp, int(getattr(svc_cfg, "replicas", 0) or 0))
+    # REPLICAS=0 (unset) pins the mesh replica axis to 1: TP=<n> claims
+    # exactly n devices.  The 2-D auto-fill (every leftover device into
+    # the replica axis) would silently turn TP=2 on an 8-device host
+    # into a 4x2 DP x TP grid — which the paged block pool rejects
+    # (no batch axis to shard) and which the fleet layer already covers
+    # with separate engines.  An explicit REPLICAS>1 still composes for
+    # contiguous-KV serving.
+    mesh = make_replica_tp_mesh(tp, int(getattr(svc_cfg, "replicas", 0) or 1))
     return lambda: TensorParallelSet(mesh, spec)
 
 
@@ -1043,13 +1066,17 @@ def build_model(svc_cfg, policy: DtypePolicy | None = None) -> ModelBundle:
                 "(speculative verify windows write multi-token spans "
                 "through the table; planned follow-up)"
             )
+        # Bucket alignment is no longer a rejection: ServiceConfig
+        # block-aligns the seq bucket grid at parse time (rounding up,
+        # deduped — utils/config._align_paged_seq_buckets).  Guard the
+        # invariant here for duck-typed configs that bypassed pydantic.
         bs = int(getattr(svc_cfg, "kv_block_size", 16))
         bad = [b for b in svc_cfg.seq_buckets if b % bs]
         if bad:
             raise ValueError(
-                f"KV_BLOCK_SIZE={bs} must divide every seq bucket "
-                f"(prefix sharing needs block-aligned buckets); "
-                f"offending buckets: {bad}"
+                f"KV_BLOCK_SIZE={bs} must divide every seq bucket; "
+                f"ServiceConfig aligns the grid at parse time, but this "
+                f"config bypassed it (offending buckets: {bad})"
             )
         if int(getattr(svc_cfg, "replicas", 0) or 0) > 1:
             raise ValueError(

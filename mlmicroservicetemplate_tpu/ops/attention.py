@@ -305,7 +305,7 @@ def decode_kernel_fits(t: int, kvh: int, d: int) -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret", "variant")
+    jax.jit, static_argnames=("scale", "interpret", "variant", "tp")
 )
 def decode_attention(
     q: jax.Array,  # [B, H, D] — one query per row (the decode step)
@@ -317,6 +317,7 @@ def decode_attention(
     scale: float | None = None,
     interpret: bool = False,
     variant: str = "",
+    tp: int = 1,
 ) -> jax.Array:
     """Decode-side fused attention over the KV cache; returns [B, H, D].
 
@@ -331,6 +332,23 @@ def decode_attention(
     from jax.experimental import pallas as pl
 
     from .paged_attention import parse_variant
+
+    if tp > 1:
+        # Each shard runs this kernel over its local heads; the
+        # row-parallel all-reduce lands after attn-out via sharding
+        # propagation (ops/paged_attention.tp_shard_attention).
+        from .paged_attention import tp_shard_attention
+
+        opt = () if k_scale is None else (k_scale, v_scale)
+
+        def local(q_l, kl, vl, m, *sc):
+            ks, vs = sc if sc else (None, None)
+            return decode_attention(
+                q_l, kl, vl, m, ks, vs, scale=scale,
+                interpret=interpret, variant=variant,
+            )
+
+        return tp_shard_attention(local, tp, q, (k, v), (mask,), opt)
 
     var = parse_variant(variant)
     b, h, d = q.shape

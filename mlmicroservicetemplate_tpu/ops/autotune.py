@@ -90,7 +90,7 @@ def _event(name: str) -> None:
 
 def tune_key(kind: str, *, b: int, kvh: int, n_rep: int,
              d: int, block_size: int, t: int, dtype: str,
-             quant: bool) -> str:
+             quant: bool, tp: int = 1) -> str:
     """Stable string key for one tuning problem.  Everything the
     kernel's cost surface depends on is spelled out, and NOTHING else:
     two models (or replicas) with identical decode shapes intentionally
@@ -98,17 +98,22 @@ def tune_key(kind: str, *, b: int, kvh: int, n_rep: int,
     workload, not by replica) — and because every field is derivable
     from the tensors at a kernel call site, the model code can
     reconstruct the key at trace time (:func:`lookup`) without any
-    side-channel through its frozen config."""
+    side-channel through its frozen config.  ``tp`` is the tensor-
+    parallel width the kernel runs under: each shard's kernel sees
+    kvh/tp local heads AND a different compute/VMEM surface (the
+    shard_map body), so TP entries must never alias single-device ones.
+    tp=1 appends nothing — every pre-TP persisted table stays valid."""
     q8 = "-q8" if quant else ""
+    tps = f"-tp{tp}" if int(tp) > 1 else ""
     return (
         f"{kind}/B{b}-G{kvh}-R{n_rep}-D{d}"
-        f"-bs{block_size}-T{t}-{dtype}{q8}"
+        f"-bs{block_size}-T{t}-{dtype}{q8}{tps}"
     )
 
 
 def lookup(kind: str, *, b: int, kvh: int, n_rep: int, d: int,
            block_size: int, t: int, dtype: str, quant: bool,
-           default: str = "") -> str:
+           tp: int = 1, default: str = "") -> str:
     """Trace-time variant resolution for kernel call sites: the winner
     ``ensure_tuned`` recorded for this shape, else ``default``.  The
     table only ever changes by gaining entries (warm-time sweeps/pins,
@@ -116,7 +121,8 @@ def lookup(kind: str, *, b: int, kvh: int, n_rep: int, d: int,
     RE-trace at a tuned shape resolves the same variant the warm trace
     did — variant choice is deterministic per (process, shape)."""
     key = tune_key(kind, b=b, kvh=kvh, n_rep=n_rep, d=d,
-                   block_size=block_size, t=t, dtype=dtype, quant=quant)
+                   block_size=block_size, t=t, dtype=dtype, quant=quant,
+                   tp=tp)
     with _LOCK:
         return _TABLE.get(key, default)
 
@@ -491,8 +497,13 @@ def ensure_tuned(kind: str, bundle, replicas, *, b: int, kvh: int,
     variant key the caller should thread into its serving executables'
     static descriptors.  ``table_path``: ``""`` = resolve the default
     (PALLAS_TUNE_TABLE / COMPILE_CACHE_DIR), None = no persistence."""
+    # The placement's TP width keys the table entry (tp=1 placements
+    # add nothing): sweeps under a TP mesh measure the SHARDED kernel,
+    # and their winners must never be served to single-device traces.
+    tp = int(getattr(replicas, "tp_width", 1) or 1)
     key = tune_key(kind, b=b, kvh=kvh, n_rep=n_rep, d=d,
-                   block_size=block_size, t=t, dtype=dtype, quant=quant)
+                   block_size=block_size, t=t, dtype=dtype, quant=quant,
+                   tp=tp)
     path = default_table_path() if table_path == "" else table_path
     if pin:
         var = parse_variant(pin)  # ValueError on junk: fail at boot

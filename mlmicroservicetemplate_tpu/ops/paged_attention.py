@@ -307,8 +307,48 @@ def _paged_kernel_v(*refs, scale: float, kvh: int, bs: int, quant: bool,
         o_ref[0] = (acc / jnp.maximum(l, 1e-20)[..., None]).astype(o_ref.dtype)
 
 
+def tp_shard_attention(
+    fn, tp: int, q, kv_args: tuple, rep_args: tuple,
+    scale_args: tuple = (),
+):
+    """Run a decode-attention kernel under ``shard_map`` over the
+    serving TP mesh: each shard attends over its LOCAL heads (q axis 1,
+    KV heads axis 2) — attention is embarrassingly parallel across
+    heads, so the body carries no collective; the row-parallel
+    all-reduce lands after the attn-out matmul, where XLA's sharding
+    propagation puts it.  ``rep_args`` (tables, masks) replicate.
+
+    The wrapper is only reachable at TP>1 — TP=1 call sites never
+    build a mesh (the no-mesh pin in tests/test_tp_serving.py)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.tpserve import serving_tp_mesh
+
+    h = q.shape[1]
+    kvh = kv_args[0].shape[2]
+    if h % tp or kvh % tp:
+        raise ValueError(
+            f"TP={tp} must divide query heads ({h}) and KV heads ({kvh})"
+        )
+    heads4 = P(None, None, "tp", None)
+    args = (q,) + tuple(kv_args) + tuple(rep_args) + tuple(scale_args)
+    in_specs = (
+        [P(None, "tp", None)]
+        + [heads4] * len(kv_args)
+        + [P(*([None] * a.ndim)) for a in rep_args]
+        + [heads4] * len(scale_args)
+    )
+    mesh = serving_tp_mesh(tp)
+    return shard_map(
+        fn, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=P(None, "tp", None), check_rep=False,
+    )(*args)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "scale", "interpret", "variant")
+    jax.jit,
+    static_argnames=("block_size", "scale", "interpret", "variant", "tp"),
 )
 def paged_decode_attention(
     q: jax.Array,  # [B, H, D] — one query per row
@@ -322,6 +362,7 @@ def paged_decode_attention(
     scale: float | None = None,
     interpret: bool = False,
     variant: str = "",
+    tp: int = 1,
 ) -> jax.Array:
     """Fused paged decode attention; returns ``[B, H, D]``.
 
@@ -338,6 +379,20 @@ def paged_decode_attention(
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if tp > 1:
+        opt = () if k_scale is None else (k_scale, v_scale)
+
+        def local(q_l, kp, vp, tbl, valid, *sc):
+            ks, vs = sc if sc else (None, None)
+            return paged_decode_attention(
+                q_l, kp, vp, tbl, valid, block_size, ks, vs,
+                scale=scale, interpret=interpret, variant=variant,
+            )
+
+        return tp_shard_attention(
+            local, tp, q, (k_pool, v_pool), (table, key_valid), opt
+        )
 
     var = parse_variant(variant)
     K = var.blocks_per_step

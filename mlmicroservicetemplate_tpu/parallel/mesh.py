@@ -111,6 +111,15 @@ class ReplicaSet:
         Part of the placement contract the engine collates against."""
         return 1
 
+    def place_decode_state(self, state, paged: bool = False):
+        """Commit a host-built decode slot state (contiguous or paged)
+        with this placement's shardings.  DP placements shard only the
+        slot axis; TP placements additionally shard every KV-cache
+        leaf's heads axis over 'tp' (override below)."""
+        import jax
+
+        return jax.device_put(state, self.batch_sharding)
+
 
 def make_sp_mesh(n_devices: int = 0, devices=None):
     """``('sp',)`` mesh for sequence-parallel (ring attention) serving."""
@@ -192,15 +201,57 @@ class TensorParallelSet(ReplicaSet):
 
         # Top-level subtrees the spec doesn't describe (e.g. a cached
         # prompt-prefix KV attached after the spec was built) replicate
-        # — always correct, just not tp-sharded.
+        # — always correct, just not tp-sharded.  ``may_alias``: a leaf
+        # already resident with a compatible layout (a fleet spawn
+        # re-placing the donor's sharded params, a supervised rebuild
+        # re-placing its own) reuses the buffer instead of copying —
+        # placement cost scales with what MOVED, not with model size.
         spec = dict(self.param_spec)
         for key in params:
             if key not in spec:
                 spec[key] = jax.tree.map(lambda _: P(), params[key])
         return jax.tree.map(
-            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            lambda p, s: jax.device_put(
+                p, NamedSharding(self.mesh, s), may_alias=True
+            ),
             params, spec,
         )
+
+    def place_decode_state(self, state, paged: bool = False):
+        """KV-cache leaves shard their heads axis over 'tp' (pool
+        blocks ``[NB, BS, H, D]`` and contiguous slabs ``[B, S, H, D]``
+        alike — parallel/tpserve.kv_head_spec); every other field
+        keeps the DP slot sharding.  Spec slot states shard their
+        ``base`` the same way (the drafting history has no head axis).
+        One logical pool, per-shard buffers: block ids, tables and the
+        free-list/refcount ledger never see the mesh."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        from .tpserve import kv_head_spec
+
+        def kv_shard(x):
+            # Heads axis must split evenly (registry validates real TP
+            # configs; duck-typed test states just replicate).
+            if (getattr(x, "ndim", 0) >= 3
+                    and x.shape[2] % self.tp_width == 0):
+                return NamedSharding(
+                    self.mesh, kv_head_spec(paged, x.ndim)
+                )
+            return self.batch_sharding
+
+        def shardings(st):
+            tree = jax.tree.map(lambda _: self.batch_sharding, st)
+            if hasattr(st, "base"):  # SpecState wrapper
+                return tree._replace(base=shardings(st.base))
+            if hasattr(st, "cache_k"):
+                tree = tree._replace(
+                    cache_k=jax.tree.map(kv_shard, st.cache_k),
+                    cache_v=jax.tree.map(kv_shard, st.cache_v),
+                )
+            return tree
+
+        return jax.device_put(state, shardings(state))
 
     def pad_multiple(self) -> int:
         return self.n_replicas

@@ -1,0 +1,148 @@
+"""Serving-side tensor-parallel helpers (ROADMAP item 1).
+
+``parallel/tp.py`` owns the Megatron layout rules (column-parallel
+q/k/v + mlp-up, row-parallel attn-out + mlp-down) as PartitionSpec
+pytrees; ``parallel/mesh.py`` owns the placement objects.  This module
+is the small trace-time surface the REST of the serving stack needs:
+
+- ``serving_tp_mesh(tp)`` — the cached ``('replica','tp')`` mesh an
+  ops-level ``shard_map`` wrapper reconstructs at trace time from the
+  STATIC tp width in the model config (model fns are pure; they cannot
+  reach the engine's placement object, but the mesh over the first
+  ``tp`` visible devices is deterministic and identical to the one
+  ``make_replica_tp_mesh(tp, 1)`` built for the engine).
+- ``kv_head_spec(paged)`` — the one KV-cache layout rule: every cache
+  leaf (contiguous ``[B, S, H, D]`` slab, pool ``[NB, BS, H, D]``
+  block, or int8 scale ``[..., H]``) shards its HEADS axis (axis 2)
+  over 'tp'.  Block ids, tables, free-lists and refcounts never see a
+  device axis — the pool stays one logical pool with one ledger.
+- ``placement_fingerprint(placement)`` — a short stable string naming
+  the mesh topology + param layout, mixed into the executable-cache
+  and autotuner keys so TP executables can never alias single-device
+  (or differently-laid-out) ones.
+
+TP=1 (the default) calls NONE of this: no mesh object is built
+anywhere, pinned by ``tests/test_tp_serving.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_MESH_CACHE: dict = {}
+_LOCK = threading.Lock()
+
+
+def serving_tp_mesh(tp: int, replicas: int = 1):
+    """Cached ``('replica','tp')`` mesh over the first ``replicas*tp``
+    visible devices — bit-identical (compares/hashes equal) to the
+    engine placement's mesh, so a ``shard_map`` traced against it
+    composes with operands committed by ``TensorParallelSet``."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    key = (int(tp), int(replicas))
+    with _LOCK:
+        mesh = _MESH_CACHE.get(key)
+        if mesh is None:
+            need = key[0] * key[1]
+            devs = jax.devices()
+            if need > len(devs):
+                raise ValueError(
+                    f"TP={tp} x replicas={replicas} needs {need} devices, "
+                    f"only {len(devs)} visible"
+                )
+            mesh = Mesh(
+                np.array(devs[:need]).reshape(key[1], key[0]),
+                ("replica", "tp"),
+            )
+            _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def kv_head_spec(paged: bool, ndim: int = 4):
+    """PartitionSpec for one KV-cache leaf: heads axis (2) over 'tp'.
+
+    Contiguous slabs additionally shard their batch axis (0) over
+    'replica'; pool leaves must NOT (axis 0 is the block id space —
+    device-agnostic by contract, and PAGED_KV pins REPLICAS=1)."""
+    from jax.sharding import PartitionSpec as P
+
+    lead = None if paged else "replica"
+    tail = [None] * max(0, ndim - 3)
+    return P(lead, None, "tp", *tail)
+
+
+def placement_fingerprint(placement) -> str:
+    """Stable short name of a placement's mesh topology + param layout
+    for cache keying.  "" for plain single-mesh replica placements
+    (keeps every pre-TP cache/autotune key byte-identical)."""
+    mesh = getattr(placement, "mesh", None)
+    if mesh is None:
+        return ""
+    try:
+        axes = ",".join(f"{a}{int(n)}" for a, n in mesh.shape.items())
+    except Exception:
+        return ""
+    spec = getattr(placement, "param_spec", None)
+    if spec is None and axes in ("replica1", ""):
+        return ""  # degenerate 1-device DP mesh == no placement axis
+    tag = type(placement).__name__
+    if spec is not None:
+        import hashlib
+
+        import jax
+        from jax.sharding import PartitionSpec
+
+        leaves = jax.tree.leaves(
+            spec, is_leaf=lambda x: isinstance(x, PartitionSpec)
+        )
+        digest = hashlib.sha1(
+            "|".join(str(s) for s in leaves).encode()
+        ).hexdigest()[:10]
+        return f"{tag}({axes})#{digest}"
+    return f"{tag}({axes})"
+
+
+def collective_probe(mesh, d_model: int, dtype="float32") -> dict:
+    """Measured ICI collective latency over the serving mesh, per op —
+    feeds ``tp_collective_seconds{op}`` at warm time (the serve path
+    cannot separate collective from compute inside one executable, so
+    the series reports a calibrated per-op probe, re-measured at every
+    warm; docs/tensor-parallel.md documents the semantics)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tp = int(mesh.shape.get("tp", 1))
+    if tp <= 1:
+        return {}
+    x = jnp.ones((max(1, d_model // tp), max(8, d_model)), dtype)
+    xs = jax.device_put(x, NamedSharding(mesh, P("tp", None)))
+
+    from jax.experimental.shard_map import shard_map
+
+    # check_rep=False: the static replication checker cannot infer
+    # out-replication over 'tp' for these one-op bodies on a 2-D mesh;
+    # the probe is a timing harness, not a correctness surface.
+    psum = jax.jit(shard_map(
+        lambda v: jax.lax.psum(v, "tp"), mesh=mesh,
+        in_specs=P("tp", None), out_specs=P(None, None),
+        check_rep=False,
+    ))
+    gather = jax.jit(shard_map(
+        lambda v: jax.lax.all_gather(v, "tp", axis=0, tiled=True),
+        mesh=mesh, in_specs=P("tp", None), out_specs=P(None, None),
+        check_rep=False,
+    ))
+    out = {}
+    for op, fn in (("all_reduce", psum), ("all_gather", gather)):
+        jax.block_until_ready(fn(xs))  # compile + warm outside the clock
+        t0 = time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(fn(xs))
+        out[op] = (time.perf_counter() - t0) / 3.0
+    return out

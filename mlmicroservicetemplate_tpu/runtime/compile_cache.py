@@ -161,14 +161,24 @@ def bundle_fingerprint(bundle: Any) -> str:
 
 
 def placement_key(replicas: Any) -> tuple:
-    """Hashable descriptor of the device set an engine dispatches onto.
-    Engines sharing one ReplicaSet (every fleet replica today) get the
-    same key; distinct meshes/device sets never share."""
+    """Hashable descriptor of the device set an engine dispatches onto
+    PLUS its sharding layout.  Engines sharing one ReplicaSet (every
+    fleet replica today) get the same key; distinct meshes/device sets
+    never share — and neither do distinct LAYOUTS over the same
+    devices: a TP=2 ``('replica','tp')`` mesh and a REPLICAS=2 DP mesh
+    cover the same two chips but compile different SPMD programs, so
+    the key carries a mesh-topology + PartitionSpec fingerprint
+    (parallel/tpserve.py).  Single-device placements fingerprint to ""
+    — every pre-TP key stays byte-identical."""
     mesh = getattr(replicas, "mesh", None)
     devs = getattr(mesh, "devices", None)
     if devs is not None:
         try:
-            return tuple(str(d) for d in devs.flat)
+            from ..parallel.tpserve import placement_fingerprint
+
+            return (placement_fingerprint(replicas),) + tuple(
+                str(d) for d in devs.flat
+            )
         except Exception:
             pass
     return ("replicas", id(replicas))

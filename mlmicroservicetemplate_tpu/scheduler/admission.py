@@ -68,6 +68,12 @@ class AdmissionController:
         # pool hasn't claimed.
         self.paged = bool(getattr(engine, "paged_kv", False))
         self.pool = getattr(engine, "kv_pool", None)
+        # TP width for the per-shard pool gauge: one logical pool whose
+        # blocks split their heads axis across the 'tp' mesh — every
+        # shard's residency is by construction identical.
+        self.tp_width = int(getattr(
+            getattr(engine, "replicas", None), "tp_width", 1
+        ) or 1)
         # Elastic-fleet budget re-split (engine/fleet.py): a LEDGER cap
         # in blocks below the pool's physical size — the fleet re-sets
         # it on every scale/evict/rejoin event so the live replicas
@@ -149,6 +155,10 @@ class AdmissionController:
             metrics.KV_POOL_BLOCKS.labels(
                 self.model, self.replica, "free"
             ).set(self.pool.free_blocks)
+            for shard in range(self.tp_width):
+                metrics.KV_POOL_SHARD_BLOCKS.labels(
+                    self.model, str(shard)
+                ).set(self.pool.used_blocks)
 
     # -- classification ------------------------------------------------
 

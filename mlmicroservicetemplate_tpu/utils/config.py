@@ -218,8 +218,9 @@ class ServiceConfig(BaseModel):
     # turns KV_BUDGET_MB from a worst-case gate into live-token
     # occupancy (docs/kv-paging.md).  Default off = the seed layout.
     paged_kv: bool = False
-    # Tokens per KV block in paged mode.  Must divide every seq bucket
-    # (prefix sharing relies on bucket-aligned block boundaries).
+    # Tokens per KV block in paged mode.  Unaligned seq buckets are
+    # rounded UP to this grid at parse (_align_paged_seq_buckets) —
+    # prefix sharing relies on bucket-aligned block boundaries.
     kv_block_size: int = 16
     # -- Pallas decode-kernel selection (docs/kernel_tuning.md) --------
     # Measured kernel-variant sweep at warmup (ops/autotune.py): every
@@ -913,6 +914,43 @@ class ServiceConfig(BaseModel):
                 f"<= FLEET_REPLICAS <= FLEET_MAX_REPLICAS, got "
                 f"{mn} <= {n} <= {mx}"
             )
+        return self
+
+    @model_validator(mode="after")
+    def _check_tp_knob(self):
+        # Tensor-parallel serving (TP>1; docs/tensor-parallel.md).
+        # Composition limits fail at config parse, not first trace:
+        # QUANTIZE's {'q8','scale'} weight subtrees have no TP layout
+        # (same contract the registry enforces — "TP and QUANTIZE"),
+        # and SP/TP compose via a 3-D mesh this engine doesn't build.
+        if self.tp > 1:
+            if self.quantize:
+                raise ValueError(
+                    "TP and QUANTIZE cannot combine (quantized leaves "
+                    "are {'q8','scale'} subtrees the TP param spec "
+                    "cannot shard); pick one"
+                )
+            if self.sp > 1:
+                raise ValueError(
+                    "TP and SP cannot combine (a ('replica','sp','tp') "
+                    "mesh is not built); pick one parallelism axis"
+                )
+        return self
+
+    @model_validator(mode="after")
+    def _align_paged_seq_buckets(self):
+        # PAGED_KV: block-align the bucket grid at BUILD time instead
+        # of rejecting unaligned grids (prefix sharing and table-span
+        # writes need block-aligned bucket boundaries).  Rounding UP
+        # never shrinks an admissible prompt; collapsing duplicates
+        # keeps the grid strictly ascending.  Aligned grids (the
+        # default 16-multiples) pass through byte-identical.
+        if self.paged_kv and self.kv_block_size > 1:
+            bs = self.kv_block_size
+            aligned = tuple(sorted({-(-b // bs) * bs
+                                    for b in self.seq_buckets}))
+            if aligned != self.seq_buckets:
+                self.seq_buckets = aligned
         return self
 
     @field_validator("fault_spec")

@@ -492,6 +492,23 @@ SLO_TBT_BURN = Gauge(
     "(SLO_TBT_MS knobs)",
     ["model", "klass", "window"],
 )
+TP_COLLECTIVE_SECONDS = Gauge(
+    "tp_collective_seconds",
+    "Measured wall time of one d_model-sized collective over the "
+    "('replica','tp') serving mesh, by op (all_reduce = the row-"
+    "parallel psum every decode layer pays, all_gather = the logits "
+    "gather) — probed once at engine warm (parallel/tpserve.py); a "
+    "step change flags ICI vs host-hop placement drift",
+    ["model", "op"],
+)
+KV_POOL_SHARD_BLOCKS = Gauge(
+    "kv_pool_shard_blocks",
+    "Paged-KV blocks resident per TP shard (TP>1: every block splits "
+    "its heads axis across shards, so the shards MUST stay equal — "
+    "one logical pool, device-agnostic block ids; divergence means a "
+    "sharding bug).  TP=1 emits shard 0 only",
+    ["model", "shard"],
+)
 
 
 def render() -> tuple[bytes, str]:

@@ -257,6 +257,21 @@ else
     echo "== multi-tenant smoke skipped (TENANT_SMOKE=0) =="
 fi
 
+# Tensor-parallel smoke (docs/tensor-parallel.md): a TP=2 paged
+# engine over the 8 virtual host devices under a fatal chunk fault —
+# recovery must complete every stream token-identically to an
+# unfaulted TP=1 run and drain the sharded pool's single ledger to
+# zero (chaos tier, so it stays out of tier-1).  TP_SMOKE=0 skips.
+if [ "${TP_SMOKE:-1}" != "0" ]; then
+    echo "== tensor-parallel smoke (TP=2 + chunk:fatal@2, LOCKTRACE=1) =="
+    timeout -k 10 240 env JAX_PLATFORMS=cpu LOCKTRACE=1 \
+        TP_SMOKE_SPEC="${TP_SMOKE_SPEC:-chunk:fatal@2}" \
+        python -m pytest tests/test_tp_serving.py::test_tp_smoke_chaos \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== tensor-parallel smoke skipped (TP_SMOKE=0) =="
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -46,6 +46,17 @@ sys.path.insert(0, os.path.join(_root, "benchmarks"))
 
 BASELINE_PATH = os.path.join(_root, "benchmarks", "perf_baseline.json")
 
+# The TP=2 workload needs a multi-device mesh; outside pytest the
+# conftest's virtual-device flag is absent, so set it here (it only
+# affects the host platform — a real TPU run is untouched).  Must
+# happen before the first jax import.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
 #: counter -> (comparator, tolerance).  "eq" = exact, "le" = current
 #: must not exceed baseline*(1+tol), "ge" = must not fall below
 #: baseline*(1-tol).
@@ -60,6 +71,15 @@ RULES = {
     "prep_staged": ("ge", 0.34),
     "autotune_variants_swept": ("eq", 0.0),
     "autotune_installs": ("eq", 0.0),
+    # r23 tensor-parallel structural counters: the same dispatch
+    # arithmetic must hold with the KV pool sharded over a TP=2 mesh,
+    # and serving after warm stays zero-compile (the TP executables
+    # key separately — a placement-fingerprint regression shows up
+    # here as a request-path compile).
+    "tp_tokens": ("eq", 0.0),
+    "tp_chunk_dispatches": ("eq", 0.0),
+    "tp_prefill_dispatches": ("eq", 0.0),
+    "tp_xla_compiles_serving": ("eq", 0.0),
 }
 
 
@@ -123,6 +143,66 @@ def run_workload() -> dict:
     return counters
 
 
+def run_tp_workload() -> dict:
+    """The same tiny paged workload at TP=2 over the virtual host
+    devices (no Pallas — the jnp path under shard_map is the TP
+    production path on CPU CI).  Counters land under a ``tp_``
+    prefix."""
+    import numpy as np
+
+    from helpers import tiny_gpt_bundle
+    from mlmicroservicetemplate_tpu.engine import InferenceEngine
+    from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+    from mlmicroservicetemplate_tpu.parallel import (
+        TensorParallelSet,
+        make_replica_tp_mesh,
+    )
+    from mlmicroservicetemplate_tpu.parallel.tp import gpt_param_spec
+    from mlmicroservicetemplate_tpu.runtime.compile_cache import CompileWindow
+    from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+    from perf_ledger import append_row, structural_counters
+
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, batch_buckets=(1, 2),
+        seq_buckets=(8, 16), max_decode_len=16, stream_chunk_tokens=4,
+        max_streams=2, stream_pipeline=1, paged_kv=True, kv_block_size=4,
+    )
+    bundle = tiny_gpt_bundle(tp=2)
+    engine = InferenceEngine(
+        bundle, cfg,
+        TensorParallelSet(make_replica_tp_mesh(tp=2, replicas=1),
+                          gpt_param_spec(bundle.cfg)),
+    )
+    cdl = ContinuousDecodeLoop(engine, cfg)
+    cdl.warm()
+
+    async def drive():
+        for i in range(2):
+            feats = {
+                "input_ids": np.arange(1, 9, dtype=np.int32) + i,
+                "length": np.int32(8),
+                "max_tokens": 16,
+            }
+            out = []
+            async for chunk in cdl.submit_stream(feats):
+                out.extend(chunk.tolist())
+            assert len(out) == 16, f"tp stream {i} produced {len(out)} tokens"
+
+    with CompileWindow() as w:
+        asyncio.run(drive())
+    import time
+
+    for _ in range(100):
+        if cdl.idle() and not cdl._inflight_chunks:
+            break
+        time.sleep(0.02)
+    counters = structural_counters(engine, cdl)
+    counters["xla_compiles_serving"] = w.compiles
+    cdl.stop()
+    append_row("perf_smoke tiny-gpt paged tp2", counters)
+    return {f"tp_{k}": v for k, v in counters.items()}
+
+
 def compare(current: dict, baseline: dict) -> list[str]:
     failures = []
     for key, (cmp_, tol) in RULES.items():
@@ -146,6 +226,7 @@ def compare(current: dict, baseline: dict) -> list[str]:
 
 def main() -> int:
     counters = run_workload()
+    counters.update(run_tp_workload())
     flat = {k: v for k, v in counters.items() if k in RULES}
     if os.environ.get("PERF_SMOKE_UPDATE", "").lower() in ("1", "true", "yes"):
         with open(BASELINE_PATH, "w") as f:

@@ -365,8 +365,11 @@ def test_build_model_gates():
             build_model(load_config(dict(
                 base, SPEC_DECODE="ngram", SPEC_CONTINUOUS="1"
             )))
-        with pytest.raises(ValueError, match="divide every seq bucket"):
-            build_model(load_config(dict(base, SEQ_BUCKETS="24,48")))
+        # Unaligned buckets are rounded up to the block grid at parse
+        # time instead of rejected (kv_block_size defaults to 16).
+        aligned = load_config(dict(base, SEQ_BUCKETS="24,48"))
+        assert aligned.seq_buckets == (32, 48)
+        assert build_model(aligned).paged_chunk_fn is not None
         with pytest.raises(ValueError, match="REPLICAS=1"):
             build_model(load_config(dict(base, REPLICAS="2")))
     finally:

@@ -1,0 +1,369 @@
+"""Tensor-parallel serving tests (TP knob, sharded KV pools).
+
+The judged contracts:
+1. TP=2 decode through the continuous loop is TOKEN-IDENTICAL to
+   TP=1 across gpt/llama × fp32/int8-KV × contiguous/paged ×
+   greedy/pinned-seed sampled — sharding the heads axis over the
+   ('replica','tp') mesh changes the physical layout only.
+2. Under PAGED_KV the pool stays ONE logical pool: block ids are
+   device-agnostic (axis 0 of the pool is never sharded), the KV
+   leaves carry 'tp' on the heads axis, and the single free-list
+   ledger drains to zero when streams end.
+3. TP=1 (the default) builds no mesh object anywhere — the bit-
+   identity pin that keeps every pre-TP deployment byte-stable.
+4. TP executables can never alias single-device ones: compile-cache
+   placement keys and autotune tune keys both carry the placement
+   fingerprint.  Serving a second stream at TP=2 performs ZERO XLA
+   compiles (the r19 zero-compile pin extends to TP).
+5. Config validators: TP×QUANTIZE and TP×SP reject at parse,
+   TP must divide the attention heads, unaligned paged seq buckets
+   are block-aligned at parse instead of rejected.
+
+CPU runs force 8 host devices (conftest.py sets
+``--xla_force_host_platform_device_count=8``), so a real 2-way mesh
+exists to shard over.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine.streams import ContinuousDecodeLoop
+from mlmicroservicetemplate_tpu.parallel import (
+    ReplicaSet,
+    TensorParallelSet,
+    make_mesh,
+    make_replica_tp_mesh,
+)
+from mlmicroservicetemplate_tpu.parallel.tp import gpt_param_spec, llama_param_spec
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig
+
+from helpers import tiny_gpt_bundle, tiny_llama_bundle
+
+
+def _cfg(**kw) -> ServiceConfig:
+    kw.setdefault("device", "cpu")
+    kw.setdefault("warmup", False)
+    kw.setdefault("batch_buckets", (1, 2))
+    kw.setdefault("seq_buckets", (16,))
+    kw.setdefault("max_decode_len", 8)
+    kw.setdefault("stream_chunk_tokens", 4)
+    kw.setdefault("max_streams", 4)
+    return ServiceConfig(**kw)
+
+
+def _engine(model: str, tp: int, cfg: ServiceConfig, kv_quant: bool = False):
+    if model == "gpt":
+        mk, spec_fn = tiny_gpt_bundle, gpt_param_spec
+        bundle = mk(**({"tp": tp} if tp > 1 else {}))
+    else:
+        mk, spec_fn = tiny_llama_bundle, llama_param_spec
+        bundle = mk(kv_quant=kv_quant, **({"tp": tp} if tp > 1 else {}))
+    if tp > 1:
+        placement = TensorParallelSet(
+            make_replica_tp_mesh(tp=tp, replicas=1), spec_fn(bundle.cfg)
+        )
+    else:
+        placement = ReplicaSet(make_mesh(1))
+    return InferenceEngine(bundle, cfg, placement)
+
+
+async def _consume(gen):
+    out = []
+    async for c in gen:
+        out.extend(np.asarray(c).tolist())
+    return out
+
+
+def _run_streams(cdl, feats_list):
+    async def body():
+        return await asyncio.gather(
+            *[_consume(cdl.submit_stream(dict(f))) for f in feats_list]
+        )
+
+    return asyncio.run(body())
+
+
+def _feats(seed: int = 0, n: int = 8):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(2, 200, n).astype(np.int32),
+        "length": np.int32(n),
+    }
+
+
+def _sampled_feats(seed: int = 3):
+    f = _feats(seed)
+    f.update(temperature=0.8, top_k=0, top_p=1.0, seed=1234)
+    return f
+
+
+def _first_kv_leaf(state):
+    leaf = state.cache_k[0]
+    return leaf[0] if isinstance(leaf, tuple) else leaf
+
+
+def _drain(pool, timeout: float = 5.0) -> int:
+    deadline = time.monotonic() + timeout
+    while pool.used_blocks > 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return pool.used_blocks
+
+
+# ---------------------------------------------------------------------------
+# token-identity matrix
+
+
+@pytest.mark.parametrize(
+    "model,kv_quant,paged",
+    [
+        ("gpt", False, False),
+        ("gpt", False, True),
+        ("llama", False, True),
+        ("llama", True, False),
+        ("llama", True, True),
+    ],
+    ids=["gpt-contig", "gpt-paged", "llama-paged", "llama-int8-contig",
+         "llama-int8-paged"],
+)
+def test_tp2_matches_tp1_through_loop(model, kv_quant, paged):
+    """One greedy and one pinned-seed sampled stream through the
+    continuous loop: TP=2 tokens == TP=1 tokens, per stream."""
+    kw = {"paged_kv": True, "kv_block_size": 8} if paged else {}
+    cfg = _cfg(**kw)
+    feats = [_feats(0), _sampled_feats()]
+
+    outs = {}
+    for tp in (1, 2):
+        eng = _engine(model, tp, cfg, kv_quant=kv_quant)
+        cdl = ContinuousDecodeLoop(eng, cfg)
+        try:
+            outs[tp] = _run_streams(cdl, feats)
+            if paged:
+                leaf = _first_kv_leaf(cdl._state)
+                spec = getattr(leaf.sharding, "spec", None)
+                if tp == 2:
+                    # heads axis (2) sharded over 'tp'; block-id axis
+                    # (0) replicated — ids stay device-agnostic.
+                    assert spec is not None and spec[2] == "tp", spec
+                    assert spec[0] is None, spec
+                else:
+                    assert spec is None or "tp" not in tuple(spec), spec
+                assert _drain(cdl.pool) == 0
+        finally:
+            cdl.stop()
+
+    assert outs[2][0] == outs[1][0], "greedy stream diverged under TP=2"
+    assert outs[2][1] == outs[1][1], "pinned-seed sampled stream diverged"
+
+
+def test_tp2_second_stream_zero_compiles():
+    """The r19 zero-compile pin extends to TP=2: after the first
+    stream warmed every bucketed executable, serving another stream
+    (same buckets) performs no XLA compiles."""
+    from mlmicroservicetemplate_tpu.runtime import compile_cache as cc
+
+    cfg = _cfg(paged_kv=True, kv_block_size=8)
+    eng = _engine("gpt", 2, cfg)
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        _run_streams(cdl, [_feats(0)])
+        with cc.CompileWindow() as w:
+            _run_streams(cdl, [_feats(7)])
+        assert w.compiles == 0, f"TP=2 serve-time compiles: {w.compiles}"
+    finally:
+        cdl.stop()
+
+
+# ---------------------------------------------------------------------------
+# TP=1 no-mesh pin
+
+
+def test_tp1_default_builds_no_serving_mesh():
+    """TP=1 (the default) must not build a serving mesh object — the
+    single-device path stays bit-identical to the pre-TP code."""
+    from mlmicroservicetemplate_tpu.parallel import tpserve
+
+    tpserve._MESH_CACHE.clear()
+    cfg = _cfg(paged_kv=True, kv_block_size=8)
+    eng = _engine("gpt", 1, cfg)
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    try:
+        toks = _run_streams(cdl, [_feats(0)])[0]
+        assert len(toks) > 0
+    finally:
+        cdl.stop()
+    assert tpserve._MESH_CACHE == {}, "TP=1 built a serving mesh"
+    # And the model config carries the default statically.
+    assert tiny_gpt_bundle().cfg.tp == 1
+    assert tiny_llama_bundle().cfg.tp == 1
+
+
+# ---------------------------------------------------------------------------
+# keying: TP executables / tuned variants never alias single-device ones
+
+
+def test_placement_keys_never_alias():
+    from mlmicroservicetemplate_tpu.runtime.compile_cache import placement_key
+
+    rs = ReplicaSet(make_mesh(1))
+    b = tiny_gpt_bundle(tp=2)
+    mesh = make_replica_tp_mesh(tp=2, replicas=1)
+    tp_a = TensorParallelSet(mesh, gpt_param_spec(b.cfg))
+    tp_b = TensorParallelSet(mesh, gpt_param_spec(b.cfg))
+
+    assert placement_key(rs) != placement_key(tp_a)
+    # Same mesh + same param spec → the SAME key (fleet replicas in
+    # one TP group share executables)...
+    assert placement_key(tp_a) == placement_key(tp_b)
+    # ...and single-device keys carry no fingerprint, so every pre-TP
+    # cache entry stays byte-identical.
+    assert placement_key(rs)[0] == ""
+
+    dp = ReplicaSet(make_mesh(2))
+    assert placement_key(dp) != placement_key(tp_a), (
+        "a REPLICAS=2 DP mesh and a TP=2 mesh cover the same devices "
+        "but must never share executables"
+    )
+
+
+def test_tune_key_carries_tp_width():
+    from mlmicroservicetemplate_tpu.ops.autotune import tune_key
+
+    kw = dict(b=2, kvh=4, n_rep=1, d=16, block_size=8, t=32,
+              dtype="float32", quant=False)
+    assert tune_key("paged_decode", **kw) != tune_key(
+        "paged_decode", tp=2, **kw
+    )
+    # tp=1 appends nothing: persisted pre-TP tables stay valid.
+    assert tune_key("paged_decode", **kw) == tune_key(
+        "paged_decode", tp=1, **kw
+    )
+    assert tune_key("paged_decode", tp=2, **kw).endswith("-tp2")
+
+
+# ---------------------------------------------------------------------------
+# config validators
+
+
+def test_tp_knob_validators():
+    with pytest.raises(ValueError, match="TP and QUANTIZE"):
+        ServiceConfig(device="cpu", warmup=False, tp=2, quantize="int8")
+    with pytest.raises(ValueError, match="TP and SP"):
+        ServiceConfig(device="cpu", warmup=False, tp=2, sp=2)
+
+
+def test_tp_must_divide_heads():
+    import json
+    import os
+
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+
+    from helpers import TINY_LLAMA
+
+    os.environ["LLAMA_CONFIG"] = json.dumps(
+        {k: v for k, v in TINY_LLAMA.items() if k not in ("eos_id", "pad_id")}
+    )
+    try:
+        # TINY_LLAMA: num_heads=4, num_kv_heads=2 — 3 divides neither.
+        with pytest.raises(ValueError, match="divide attention heads"):
+            build_model(ServiceConfig(
+                device="cpu", model_name="llama", warmup=False, tp=3,
+                seq_buckets=(32, 64), batch_buckets=(1, 2),
+            ))
+    finally:
+        del os.environ["LLAMA_CONFIG"]
+
+
+def test_registry_tp_boot_claims_exactly_tp_devices():
+    """Server-boot regression: with REPLICAS unset, the registry's TP
+    placement must pin the mesh replica axis to 1 (TP=2 claims exactly
+    2 devices).  The 2-D auto-fill used to grab every leftover visible
+    device into the replica axis (4x2 on the 8-device host), which the
+    paged block pool then rejected at engine init — TP=2 + PAGED_KV
+    could never boot through ``build_model``/``serve``."""
+    import json
+    import os
+
+    from mlmicroservicetemplate_tpu.models.registry import build_model
+
+    from helpers import TINY_LLAMA
+
+    os.environ["LLAMA_CONFIG"] = json.dumps(
+        {k: v for k, v in TINY_LLAMA.items() if k not in ("eos_id", "pad_id")}
+    )
+    try:
+        cfg = ServiceConfig(
+            device="cpu", model_name="llama", warmup=False, tp=2,
+            paged_kv=True, kv_block_size=8,
+            seq_buckets=(32,), batch_buckets=(1, 2), max_decode_len=8,
+        )
+        bundle = build_model(cfg)
+        # replicas=None: the engine resolves bundle.make_placement —
+        # the exact serve.py boot order.
+        eng = InferenceEngine(bundle, cfg)
+        assert eng.replicas.tp_width == 2
+        assert eng.replicas.n_replicas == 1
+        assert eng.kv_pool is not None
+    finally:
+        del os.environ["LLAMA_CONFIG"]
+
+
+# ---------------------------------------------------------------------------
+# chaos smoke (scripts/check.sh TP_SMOKE stage; chaos tier, out of tier-1)
+
+
+@pytest.mark.chaos
+def test_tp_smoke_chaos():
+    """check.sh TP_SMOKE entry: a TP=2 paged engine under a fatal
+    chunk fault (TP_SMOKE_SPEC, default chunk:fatal@2) must recover
+    through the supervisor token-identically to an unfaulted TP=1
+    run, and the sharded pool's single ledger drains to zero."""
+    import os
+
+    from mlmicroservicetemplate_tpu.engine.supervisor import Supervisor
+
+    spec = os.environ.get("TP_SMOKE_SPEC", "chunk:fatal@2")
+    base = dict(paged_kv=True, kv_block_size=8, max_decode_len=16)
+    ref_cfg = _cfg(**base)
+    ref = _engine("gpt", 1, ref_cfg)
+    feats = [_feats(0), _feats(7)]
+    solos = []
+    ref_cdl = ContinuousDecodeLoop(ref, ref_cfg)
+    try:
+        solos = _run_streams(ref_cdl, feats)
+    finally:
+        ref_cdl.stop()
+
+    # No tight watchdog: TP=2 on CPU shares one core across 8 host
+    # devices and the first shard_map dispatch carries its compile —
+    # this smoke pins fault RECOVERY, not dispatch latency.
+    cfg = _cfg(fault_spec=spec, dispatch_retries=2,
+               dispatch_backoff_s=0.01, **base)
+    eng = _engine("gpt", 2, cfg)
+    cdl = ContinuousDecodeLoop(eng, cfg)
+    cdl.supervisor = Supervisor(cfg)
+    try:
+        outs = _run_streams(cdl, feats)
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            assert got[:n] == want[:n]
+        assert _drain(cdl.pool) == 0
+    finally:
+        cdl.stop()
+
+
+def test_unaligned_paged_buckets_align_at_parse():
+    cfg = ServiceConfig(
+        device="cpu", warmup=False, paged_kv=True, kv_block_size=16,
+        seq_buckets=(24, 48, 100),
+    )
+    assert cfg.seq_buckets == (32, 48, 112)
+    # Non-paged configs keep their grid untouched.
+    cfg2 = ServiceConfig(device="cpu", warmup=False, seq_buckets=(24, 48))
+    assert cfg2.seq_buckets == (24, 48)
