@@ -1,0 +1,219 @@
+"""Device-loss A/B: goodput + streams-lost ledger through a lost chip,
+fleet-with-spare TP groups vs a single TP group.
+
+The judged claim (ISSUE 19): with the SAME deterministic device-loss
+schedule (``chunk:device_lost@3`` — a runtime-shaped ``XlaRuntimeError``
+naming a lost chip fires on the third chunk dispatch, mid-decode), a
+multi-chip fleet with a spare TP group (``FLEET_TP_GROUPS=2,2``) fails
+the dead group's streams over to the survivor and completes 100% of
+them token-identically, while the single-group deployment loses every
+live stream — losing a chip costs latency, not output, but ONLY when
+there is somewhere to go.
+
+Three arms over the same TP=2 gpt2 service (random-init weights —
+device-loss economics depend on dispatch structure, not weights):
+
+- **single-clean**: one TP=2 group, no faults (the ceiling).
+- **single-loss**:  one TP=2 group, ``chunk:device_lost@3``.  A lost
+                    chip cannot be rebuilt in place (on real hardware
+                    the device stays gone; here ENGINE_RESTARTS_MAX=0
+                    models that honestly on the virtual devices), so
+                    the whole listener's streams die with the group.
+- **fleet-spare**:  FLEET_REPLICAS=2 over ``FLEET_TP_GROUPS=2,2``,
+                    the ``r1:``-scoped schedule: replica 1's group
+                    dies the same death; its streams evacuate via
+                    placement-agnostic checkpoints onto replica 0's
+                    group, the lost chip is retired from the carve
+                    pool, and ``/readyz`` names it.
+
+N streams arrive in two waves; each reports TTFT, tokens and whether
+it terminated cleanly (a mid-stream in-band ``error`` line counts as
+failed).  Goodput = tokens delivered by error-free streams / wall.
+The streams-lost ledger (``streams_lost_total`` /
+``streams_recovered_total`` deltas per arm) rides along so the table
+shows WHERE the failed arm's tokens went.
+
+HONEST-NEGATIVE NOTE (BASELINE.md round 24): on CPU the 8 virtual
+host devices share ONE core, so the fleet-spare arm's two TP groups
+add dispatch + collective overhead with zero added FLOP throughput —
+its goodput ceiling is BELOW single-clean by construction.  The CPU
+run proves the recovery ledger (0 lost vs all lost); the capacity
+claim belongs to a real multi-chip host.
+
+    DEVICE=cpu python benchmarks/device_loss_ab.py
+    DEVLOSS_AB=0 skips it in run_all.py.
+
+One JSON line per arm to stdout, a markdown table to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+
+# Two TP=2 groups need >=4 devices; on the host platform force the
+# virtual-device split before the first jax import (no-op on TPU).
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+from harness import ServiceUnderTest, pctile  # noqa: E402
+
+N_STREAMS = int(os.environ.get("DEVLOSS_AB_N", "8"))
+LOSS_AT = os.environ.get("DEVLOSS_AB_AT", "3")
+
+PROMPTS = [
+    "the quick brown fox jumps",
+    "pack my box with five dozen",
+    "a longer prompt that spans a few more tokens than the others do",
+    "short one",
+]
+
+
+async def _one(client, i: int):
+    text = PROMPTS[i % len(PROMPTS)]
+    t0 = time.perf_counter()
+    try:
+        resp = await client.post(
+            "/predict",
+            json={"text": text, "stream": True,
+                  "max_tokens": 16 if i % 2 == 0 else 8},
+        )
+        if resp.status != 200:
+            await resp.read()
+            return {"ok": False, "status": resp.status, "tokens": 0}
+        ttft = None
+        n_tok = 0
+        failed = False
+        async for line in resp.content:
+            if not line.strip():
+                continue
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            row = json.loads(line)
+            if "error" in row:
+                failed = True
+                break
+            if row.get("done"):
+                n_tok = int(row.get("tokens_generated", 0))
+                break
+        return {"ok": not failed and n_tok > 0, "status": 200,
+                "tokens": 0 if failed else n_tok, "ttft": ttft}
+    except Exception:
+        return {"ok": False, "status": -1, "tokens": 0}
+
+
+async def _stream_ledger(client) -> dict:
+    """Sum streams_lost_total / streams_recovered_total over all label
+    children from one /metrics scrape (the prometheus registry is
+    process-global across arms, so callers diff before/after)."""
+    text = await (await client.get("/metrics")).text()
+    out = {"lost": 0.0, "recovered": 0.0}
+    for line in text.splitlines():
+        if line.startswith("streams_lost_total{"):
+            out["lost"] += float(line.rsplit(" ", 1)[1])
+        elif line.startswith("streams_recovered_total{"):
+            out["recovered"] += float(line.rsplit(" ", 1)[1])
+    return out
+
+
+async def run_arm(name: str, extra: dict, dev: dict) -> dict:
+    overrides = {
+        "MODEL_NAME": "gpt2",
+        "TP": "2",
+        "BATCH_BUCKETS": "1,4",
+        "SEQ_BUCKETS": "64",
+        "MAX_DECODE_LEN": "16",
+        "MAX_STREAMS": "4",
+        "MAX_STREAM_QUEUE": "16",
+        "WARMUP_SAMPLING": "0",
+        **extra,
+        **dev,
+    }
+    async with ServiceUnderTest(overrides) as s:
+        before = await _stream_ledger(s.client)
+        t0 = time.perf_counter()
+        first = asyncio.gather(
+            *(_one(s.client, i) for i in range(N_STREAMS // 2))
+        )
+        await asyncio.sleep(0.2)
+        second = asyncio.gather(
+            *(_one(s.client, i) for i in range(N_STREAMS // 2, N_STREAMS))
+        )
+        rows = (await first) + (await second)
+        wall = time.perf_counter() - t0
+        after = await _stream_ledger(s.client)
+        status = await (await s.client.get("/status")).json()
+        fleet = status.get("fleet") or {}
+        readyz = await s.client.get("/readyz")
+        ok = [r for r in rows if r["ok"]]
+        ttfts = [r["ttft"] for r in rows if r.get("ttft") is not None]
+        return {
+            "arm": name,
+            "offered": N_STREAMS,
+            "completed": len(ok),
+            "failed": N_STREAMS - len(ok),
+            "wall_s": round(wall, 2),
+            "goodput_tok_s": round(sum(r["tokens"] for r in ok) / wall, 1),
+            "p99_ttft_ms": round(pctile(ttfts, 0.99) * 1000, 1) if ttfts else None,
+            "streams_lost": after["lost"] - before["lost"],
+            "streams_recovered": after["recovered"] - before["recovered"],
+            "failovers": fleet.get("failovers"),
+            "lost_devices": fleet.get("lost_devices"),
+            "readyz": readyz.status,
+        }
+
+
+async def main() -> None:
+    dev = {"DEVICE": os.environ["DEVICE"]} if os.environ.get("DEVICE") else {}
+    loss_single = {
+        "FAULT_SPEC": f"chunk:device_lost@{LOSS_AT}",
+        "ENGINE_RESTARTS_MAX": "0",
+        "SUPERVISE": "1",
+    }
+    loss_fleet = {
+        "FLEET_REPLICAS": "2",
+        "FLEET_TP_GROUPS": "2,2",
+        # Round-robin so the doomed replica 1 deterministically serves
+        # streams: least-loaded + prefix affinity parks this small
+        # repeated-prompt workload entirely on replica 0 and the
+        # r1-scoped schedule would never fire.
+        "FLEET_ROUTE": "rr",
+        "FAULT_SPEC": f"r1:chunk:device_lost@{LOSS_AT}",
+        "SUPERVISE": "1",
+    }
+    rows = [
+        await run_arm("single-clean", {}, dev),
+        await run_arm("single-loss", loss_single, dev),
+        await run_arm("fleet-spare", loss_fleet, dev),
+    ]
+
+    import jax
+
+    backend = jax.default_backend()
+    print("\n| arm | completed | goodput tok/s | lost/recovered "
+          "| p99 TTFT (ms) | readyz | wall (s) |", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(
+            f"| {r['arm']} | {r['completed']}/{r['offered']} "
+            f"| {r['goodput_tok_s']} "
+            f"| {r['streams_lost']:.0f}/{r['streams_recovered']:.0f} "
+            f"| {r['p99_ttft_ms']} | {r['readyz']} | {r['wall_s']} |",
+            file=sys.stderr,
+        )
+        print(json.dumps({**r, "loss_at": LOSS_AT, "backend": backend}))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

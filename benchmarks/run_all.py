@@ -241,6 +241,16 @@ async def main() -> None:
             check=False,
         )
 
+    # Device loss (round-24 tentpole): goodput + streams-lost ledger
+    # through a lost chip mid-decode, fleet-with-spare TP groups
+    # (FLEET_TP_GROUPS=2,2, r1-scoped device_lost) vs a single TP
+    # group (every stream dies with the group).  DEVLOSS_AB=0 skips.
+    if os.environ.get("DEVLOSS_AB", "1").lower() not in ("0", "false", "no"):
+        subprocess.run(
+            [sys.executable, os.path.join(_here, "device_loss_ab.py")],
+            check=False,
+        )
+
     # Tenant fairness (round-22 tentpole): light-tenant TTFT p99 under
     # a heavy-tenant backlog, weighted fair-share dequeue (TENANTS set)
     # vs the plain class-weighted EDF queue.  TENANT_AB=0 skips.

@@ -1313,10 +1313,14 @@ async def handle_readyz(request: web.Request) -> web.Response:
         healthy = len(fleet.healthy_replicas())
         if healthy == 0:
             ra = max(1, int(math.ceil(fleet.retry_after_s())))
+            down = {"healthy": 0, "replicas": fleet.n}
+            lost = sorted(getattr(fleet, "lost_devices", ()))
+            if lost:
+                down["lost_devices"] = lost
             return web.json_response(
                 {"ready": False,
                  "error": "every fleet replica is dead",
-                 "fleet": {"healthy": 0, "replicas": fleet.n}},
+                 "fleet": down},
                 status=503, headers={"Retry-After": str(ra)},
             )
         if batcher.draining:
@@ -1330,6 +1334,12 @@ async def handle_readyz(request: web.Request) -> web.Response:
             if fleet.degraded:
                 body["degraded"] = True
                 headers["X-Fleet-Degraded"] = f"{healthy}/{fleet.n}"
+                # A degraded multi-chip fleet names WHICH devices it
+                # lost: the operator sees "chip 3 is gone" straight
+                # from the LB probe, not a replica-count riddle.
+                lost = sorted(getattr(fleet, "lost_devices", ()))
+                if lost:
+                    body["fleet"]["lost_devices"] = lost
             if getattr(fleet, "elastic", False):
                 # Scale events are invisible to readiness (a spawning
                 # replica is not routable until probed; a draining one

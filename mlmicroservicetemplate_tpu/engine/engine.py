@@ -123,8 +123,14 @@ class InferenceEngine:
         if donor_params is not None:
             from ..runtime.distributed import broadcast_params
 
-            self.params = broadcast_params(donor_params, self.replicas)
-            self.params_source = "donor"
+            self.params, moved = broadcast_params(donor_params, self.replicas)
+            # Honest transport label: "donor-ici" only when bytes
+            # actually crossed devices; same-placement spawns alias and
+            # say so (satellite of ISSUE 19 — the old flat "donor" let
+            # an alias masquerade as a copy).
+            self.params_source = "donor-ici" if moved else "donor-alias"
+            if moved:
+                metrics.FLEET_PARAM_BROADCAST.labels(bundle.name).inc(moved)
         else:
             self.params = self.replicas.place_params(bundle.params)
             self.params_source = "host"

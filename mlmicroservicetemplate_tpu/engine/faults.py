@@ -36,17 +36,24 @@ FAULT_SPEC grammar (``;``-separated rules)::
               stream live, swap = KV-tier gather/scatter/materialize
               traffic — both r18 sites, so older chunk@N schedules
               never renumber)
-    kind   := transient | fatal | hang | oob
+    kind   := transient | fatal | hang | oob | device_lost
     trigger:= "@" N ["+" M]   fire on matching dispatches N..N+M-1
             | "~" RATE        fire with probability RATE per dispatch
                               (seeded RNG: FAULT_SEED)
 
-``seconds`` only applies to ``hang`` (default 3600).  Examples:
-``chunk:fatal@5`` kills the 5th chunk dispatch;
+``seconds`` only applies to ``hang`` (default 3600); for
+``device_lost`` the parenthesized arg is instead the SHARD ordinal
+within the replica's TP group that died (default 0) — the fleet maps
+it through the replica's device set to mark the global device lost.
+Examples: ``chunk:fatal@5`` kills the 5th chunk dispatch;
 ``chunk:transient@2+3`` fails chunks 2-4 transiently;
 ``*:transient~0.05`` fails 5% of all dispatches;
 ``r1:chunk:fatal@3`` kills replica 1's 3rd chunk dispatch while every
-other replica stays clean (replica-scoped chaos — engine/fleet.py).
+other replica stays clean (replica-scoped chaos — engine/fleet.py);
+``r0:chunk:device_lost(1)@4`` kills shard 1 of replica 0's TP group on
+its 4th chunk — the whole group evacuates (one shard's arrays are
+gone, so every collective on the group is dead) and the fleet retires
+that device from future placements (engine/fleet.py).
 ``@N`` counters are per rule and count only dispatches at the rule's
 site ON the rule's replica (each replica engine owns its own injector
 with its own counters), so a schedule is reproducible run-to-run
@@ -66,7 +73,7 @@ log = logging.getLogger(__name__)
 
 SITES = ("prefill", "prefill_chunk", "chunk", "fetch", "batch", "grow",
          "handoff", "swap", "prep", "*")
-KINDS = ("transient", "fatal", "hang", "oob")
+KINDS = ("transient", "fatal", "hang", "oob", "device_lost")
 
 
 class TransientDeviceError(Exception):
@@ -77,6 +84,20 @@ class TransientDeviceError(Exception):
 class FatalDeviceError(Exception):
     """The device (state) is lost; retrying the same dispatch cannot
     succeed.  The supervisor checkpoints streams and rebuilds."""
+
+
+class DeviceLostError(FatalDeviceError):
+    """One physical device of the replica's placement died (chip
+    failure, ICI link down).  Fatal like ``FatalDeviceError`` — but an
+    in-place rebuild on the SAME placement cannot help (the device is
+    gone), so the continuous loop escalates straight to group
+    evacuation and the fleet retires the device from future
+    placements.  ``device_index`` is the shard ordinal within the
+    replica's device group (0 for single-device replicas)."""
+
+    def __init__(self, msg: str, device_index: int = 0):
+        super().__init__(msg)
+        self.device_index = int(device_index)
 
 
 class DispatchTimeoutError(Exception):
@@ -92,7 +113,38 @@ def is_transient(exc: BaseException) -> bool:
 
 
 def is_fatal_device(exc: BaseException) -> bool:
-    return isinstance(exc, (FatalDeviceError, DispatchTimeoutError))
+    # A real (non-injected) device loss carries a runtime-error type,
+    # not FatalDeviceError — it is still fatal-classified so the
+    # checkpoint-requeue path runs before the group evacuates.
+    return isinstance(
+        exc, (FatalDeviceError, DispatchTimeoutError)
+    ) or is_device_loss(exc)
+
+
+# Real runtimes surface a dead chip as an XlaRuntimeError (or peer)
+# whose message names the loss; there is no dedicated exception type to
+# isinstance against, so classification is textual — the patterns cover
+# the strings PJRT/XLA emit for halted chips, dead ICI links, and
+# DATA_LOSS-status collectives.
+_DEVICE_LOSS_RE = re.compile(
+    r"device\s+(?:is\s+)?lost|DATA_LOSS|device\s+.*halt|"
+    r"ICI\s+link|peer\s+access\s+lost|device\s+in\s+an?\s+error\s+state",
+    re.IGNORECASE,
+)
+_DEVICE_LOSS_TYPES = ("XlaRuntimeError", "JaxRuntimeError", "RpcError")
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """True when ``exc`` means a physical device (or its link) died —
+    the injected ``DeviceLostError`` or a real runtime error whose type
+    + message match the known device-loss shapes.  A device-loss is
+    always ``is_fatal_device``-fatal too; this predicate only decides
+    the ESCALATION (skip the in-place rebuild, evacuate the group)."""
+    if isinstance(exc, DeviceLostError):
+        return True
+    if type(exc).__name__ in _DEVICE_LOSS_TYPES:
+        return bool(_DEVICE_LOSS_RE.search(str(exc)))
+    return False
 
 
 class FaultRule:
@@ -126,7 +178,7 @@ class FaultRule:
 _RULE_RE = re.compile(
     r"^(?:r(?P<replica>\d+):)?"
     r"(?:(?P<site>[a-z_*]+):)?"
-    r"(?P<kind>[a-z]+)"
+    r"(?P<kind>[a-z_]+)"
     r"(?:\((?P<arg>[0-9.]+)\))?"
     r"(?:@(?P<nth>\d+)(?:\+(?P<count>\d+))?|~(?P<rate>[0-9.]+))$"
 )
@@ -155,9 +207,12 @@ def parse_spec(spec: str) -> list[FaultRule]:
         if not (0.0 <= rate <= 1.0):
             raise ValueError(f"FAULT_SPEC rate must be in [0, 1], got {rate}")
         rep = m.group("replica")
+        # arg is hang seconds (default one hour) — except device_lost,
+        # where it is the shard ordinal that dies (default shard 0).
+        default_arg = 0.0 if kind == "device_lost" else 3600.0
         rules.append(FaultRule(
             site, kind,
-            arg=float(m.group("arg") or 3600.0),
+            arg=float(m.group("arg") or default_arg),
             nth=int(m.group("nth") or 0),
             count=int(m.group("count") or 1),
             rate=rate,
@@ -221,6 +276,12 @@ class FaultInjector:
             raise TransientDeviceError(f"injected transient fault at {site}")
         if hit.kind == "fatal":
             raise FatalDeviceError(f"injected fatal device fault at {site}")
+        if hit.kind == "device_lost":
+            shard = int(hit.arg)
+            raise DeviceLostError(
+                f"injected device loss at {site} (group shard {shard})",
+                device_index=shard,
+            )
         if hit.kind == "oob":
             from .kv_blocks import OutOfBlocks
 

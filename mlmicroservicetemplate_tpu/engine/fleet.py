@@ -66,6 +66,25 @@ size), a ``ScalingGovernor`` (scheduler/policy.py) ticks every
 ``FLEET_MAX_REPLICAS`` unset (or equal to ``FLEET_REPLICAS`` with
 ``FLEET_MIN`` too) keeps the fleet static: no governor object, no
 scaler thread, bit-identical to the pre-elastic code.
+
+Multi-chip placement (ISSUE 19; docs/tensor-parallel.md): when the
+base engine sits on a TP group (``TP>1``) or ``FLEET_TP_GROUPS`` names
+per-replica widths, the fleet becomes the unit-of-placement owner: it
+CARVES the visible device list into disjoint groups — replica 0 keeps
+the base engine's devices, every other replica gets its own fresh
+group — and each group is one replica for every purpose (breaker,
+eviction, KV-budget share, governor unit).  Scale events place whole
+groups: ``_spawn_replica`` carves a free group (preferring a rejoining
+corpse's old devices, so the placement-keyed ExecutableCache makes the
+respawn compile-free), params broadcast donor→group over ICI
+(``params_source="donor-ici"``; same-placement spawns alias,
+``"donor-alias"``), and a ``device_lost`` fault retires the lost chip
+from the carve pool — the group evacuates its streams through the
+placement-agnostic checkpoint (a TP=2 stream resumes token-identically
+on a TP=1 survivor and vice versa), then rejoin rebuilds the group on
+the remaining healthy devices.  Without TP and without
+``FLEET_TP_GROUPS`` the shared single-device placement (and every one
+of its pins) is bit-identical to the pre-multichip fleet.
 """
 
 from __future__ import annotations
@@ -85,6 +104,22 @@ log = logging.getLogger(__name__)
 CLOSED, HALF_OPEN, OPEN, DEAD = 0, 1, 2, 3
 _STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open",
                 OPEN: "open", DEAD: "dead"}
+
+
+def _parse_tp_groups(spec) -> tuple[int, ...] | None:
+    """FLEET_TP_GROUPS="2,2,1" → (2, 2, 1): per-replica TP widths for
+    multi-chip carving.  None/"" → None (widths default to the base
+    engine's TP width).  utils/config.py validates the format; this
+    re-parse keeps the fleet usable with duck-typed test configs."""
+    if not spec:
+        return None
+    widths = tuple(int(w) for w in str(spec).split(",") if w.strip())
+    if not widths or any(w < 1 for w in widths):
+        raise ValueError(
+            f"FLEET_TP_GROUPS must be comma-separated widths >= 1, "
+            f"got {spec!r}"
+        )
+    return widths
 
 
 class CircuitBreaker:
@@ -187,7 +222,12 @@ class CircuitBreaker:
 
 
 class Replica:
-    """One fleet member: engine + loop + supervisor + breaker."""
+    """One fleet member: engine + loop + supervisor + breaker.
+
+    ``devices``/``width`` describe the member's placement — the global
+    device ids its mesh covers and its TP width.  A multi-chip fleet
+    carves these disjoint; single-device fleets honestly report every
+    replica on the one shared device."""
 
     def __init__(self, rid: int, engine, cdl, supervisor, admission,
                  breaker: CircuitBreaker):
@@ -203,6 +243,15 @@ class Replica:
         # Scale-down in progress: the router skips a draining replica
         # (no new work) while its loop finishes what it holds.
         self.draining = False
+        placement = getattr(engine, "replicas", None)
+        try:
+            mesh = getattr(placement, "mesh", None)
+            self.devices: tuple[int, ...] = tuple(
+                int(d.id) for d in mesh.devices.flat
+            ) if mesh is not None else ()
+        except Exception:
+            self.devices = ()
+        self.width = int(getattr(placement, "tp_width", 1) or 1)
 
     def healthy(self) -> bool:
         return (
@@ -228,7 +277,8 @@ class Replica:
 class ReplicaFleet:
     """The fleet: construction, routing, health sweeps, failover."""
 
-    def __init__(self, engine, cfg, clock=None, autoscale_thread=True):
+    def __init__(self, engine, cfg, clock=None, autoscale_thread=True,
+                 bundle_factory=None):
         from ..scheduler.router import Router
         from .engine import InferenceEngine
 
@@ -237,14 +287,36 @@ class ReplicaFleet:
                 "FLEET_REPLICAS>1 does not compose with SPEC_CONTINUOUS "
                 "(the spec load gate counts streams across one loop)"
             )
-        if getattr(engine.replicas, "n_devices", 1) > 1:
+        base_placement = engine.replicas
+        base_tp = int(getattr(base_placement, "tp_width", 1) or 1)
+        base_dev = int(getattr(base_placement, "n_devices", 1) or 1)
+        self._group_widths = _parse_tp_groups(
+            getattr(cfg, "fleet_tp_groups", None)
+        )
+        # Multi-chip carving (ISSUE 19) activates when the base engine
+        # IS one TP group, or FLEET_TP_GROUPS names widths explicitly.
+        self.multichip = (
+            (base_tp > 1 and base_dev == base_tp)
+            or self._group_widths is not None
+        )
+        if self.multichip:
+            if base_dev not in (1, base_tp):
+                # A multi-REPLICA base mesh is still the shared-mesh
+                # deadlock below — carving needs a base that is exactly
+                # one group (single device or one TP group).
+                raise ValueError(
+                    "multi-chip fleet placement requires the base "
+                    "engine on a single device or exactly one TP "
+                    "group (REPLICAS=1)"
+                )
+        elif base_dev > 1:
             # Two engines dispatching sharded computations over ONE
             # shared mesh interleave their collectives (each engine has
             # its own pipeline semaphore, so nothing orders the
             # all-gathers) — a silent rendezvous deadlock.  Fail at
             # startup instead: fleet replicas each own a single-device
-            # placement (REPLICAS=1); per-replica device assignment is
-            # the λScale follow-up (ROADMAP item 3).
+            # placement (REPLICAS=1) or — with TP>1 / FLEET_TP_GROUPS —
+            # a carved TP group of their own.
             raise ValueError(
                 "FLEET_REPLICAS>1 requires a single-device replica "
                 "placement (set REPLICAS=1): independent engines must "
@@ -298,6 +370,52 @@ class ReplicaFleet:
         per_cfg = self._share_cfg(self.n)
         split = per_cfg is not cfg
 
+        # Multi-chip carve state: disjoint per-replica device groups,
+        # a placement cache keyed (width, group) — a same-group respawn
+        # reuses the SAME placement object, so its ExecutableCache keys
+        # match and the spawn is compile-free — a per-width bundle
+        # cache, and the set of devices retired by device_lost faults.
+        self._bundle_factory = bundle_factory
+        self._bundles: dict[int, object] = {base_tp: engine.bundle}
+        self._placements: dict[tuple, object] = {}
+        self._param_spec = getattr(base_placement, "param_spec", None)
+        self._default_width = base_tp if self.multichip else 1
+        self.lost_devices: set[int] = set()
+        boot_groups: list[tuple[int, ...]] = []
+        if self.multichip:
+            widths = self._group_widths or (base_tp,) * self.n
+            if len(widths) != self.n:
+                raise ValueError(
+                    f"FLEET_TP_GROUPS names {len(widths)} groups but "
+                    f"FLEET_REPLICAS={self.n} — one width per replica"
+                )
+            if widths[0] != base_tp:
+                raise ValueError(
+                    f"FLEET_TP_GROUPS[0]={widths[0]} must equal the "
+                    f"base engine's TP width {base_tp} (replica 0 "
+                    "keeps the base placement)"
+                )
+            base_group = tuple(
+                int(d.id) for d in base_placement.mesh.devices.flat
+            )
+            self._placements[(base_tp, base_group)] = base_placement
+            boot_groups.append(base_group)
+            taken = set(base_group)
+            import jax
+
+            n_dev = len(jax.devices())
+            for w in widths[1:]:
+                free = [d for d in range(n_dev) if d not in taken]
+                if len(free) < w:
+                    raise ValueError(
+                        f"FLEET device carve needs {sum(widths)} "
+                        f"devices for groups {widths}, only {n_dev} "
+                        "visible — shrink the fleet or the TP width"
+                    )
+                grp = tuple(free[:w])
+                taken.update(grp)
+                boot_groups.append(grp)
+
         self.replicas: list[Replica] = []
         for r in range(self.n):
             if r == 0 and not (split and getattr(engine, "paged_kv", False)):
@@ -309,9 +427,17 @@ class ReplicaFleet:
                 # Boot replicas 1..R-1 broadcast params from replica
                 # 0's already-placed arrays — same λScale path live
                 # scale-ups use, so boot pays ONE host→device upload
-                # total instead of R.
+                # total instead of R.  Multi-chip boots give each
+                # replica its own carved placement (+ per-width bundle)
+                # — the broadcast is a real ICI copy for them.
+                if self.multichip and r > 0:
+                    w = widths[r]
+                    bnd = self._bundle_for(w)
+                    placement = self._placement_for(w, boot_groups[r])
+                else:
+                    bnd, placement = engine.bundle, engine.replicas
                 eng = InferenceEngine(
-                    engine.bundle, per_cfg, replicas=engine.replicas,
+                    bnd, per_cfg, replicas=placement,
                     replica_id=r, donor_params=engine.params,
                 )
             self.replicas.append(self._wire_replica(eng, per_cfg))
@@ -390,6 +516,96 @@ class ReplicaFleet:
                 update={"kv_budget_mb": self.budget_mb / live_count}
             )
         return self.cfg
+
+    def _bundle_for(self, width: int):
+        """The model bundle for a ``width``-wide replica.  The base
+        width reuses the boot bundle; other widths (a TP=1 spare next
+        to TP=2 groups) build once via ``bundle_factory`` — or, when
+        none was injected, through the model registry with ``TP``
+        overridden — and cache for every later spawn, so a serve-time
+        respawn never rebuilds (or re-reads) a bundle."""
+        width = int(width)
+        bnd = self._bundles.get(width)
+        if bnd is None:
+            if self._bundle_factory is not None:
+                bnd = self._bundle_factory(width)
+            else:
+                from ..models.registry import build_model
+
+                bnd = build_model(
+                    self.cfg.model_copy(update={"tp": width})
+                )
+            self._bundles[width] = bnd
+        return bnd
+
+    def _placement_for(self, width: int, group: tuple[int, ...]):
+        """The placement object for one carved group — cached so a
+        same-group respawn gets the SAME object (identical
+        ExecutableCache placement keys → zero serve-time compiles)."""
+        key = (int(width), tuple(group))
+        placement = self._placements.get(key)
+        if placement is None:
+            if int(width) <= 1:
+                import jax
+
+                from ..parallel.mesh import ReplicaSet, make_mesh
+
+                placement = ReplicaSet(make_mesh(
+                    1, devices=[jax.devices()[group[0]]]
+                ))
+            else:
+                from ..parallel.mesh import TensorParallelSet
+                from ..parallel.tpserve import serving_tp_mesh
+
+                if self._param_spec is None:
+                    raise ValueError(
+                        "cannot build a TP group placement without the "
+                        "base engine's param spec (base must be TP)"
+                    )
+                placement = TensorParallelSet(
+                    serving_tp_mesh(int(width), 1, group),
+                    self._param_spec,
+                )
+            self._placements[key] = placement
+        return placement
+
+    def _carve_group(self, width: int, prefer=None):
+        """Pick ``width`` free healthy devices for a new group: devices
+        held by non-dead replicas and devices retired by device_lost
+        faults are off the table.  ``prefer`` (a corpse's old group) is
+        reused when fully free — that is what keeps a same-placement
+        respawn on cached executables.  None when the host cannot seat
+        the group."""
+        import jax
+
+        n_dev = len(jax.devices())
+        used: set[int] = set(self.lost_devices)
+        for r in self.replicas:
+            if not r.dead:
+                used.update(r.devices)
+        if prefer is not None:
+            prefer = tuple(prefer)
+            if len(prefer) == int(width) and not used.intersection(prefer):
+                return prefer
+        free = [d for d in range(n_dev) if d not in used]
+        if len(free) < int(width):
+            return None
+        return tuple(free[:int(width)])
+
+    def _free_group_count(self) -> int:
+        """How many default-width groups the free healthy devices can
+        seat — the governor's ``free_groups`` signal (an "up" with no
+        seatable group returns ``(None, "no_devices")`` instead of
+        burning a doomed spawn per tick)."""
+        import jax
+
+        n_dev = len(jax.devices())
+        used: set[int] = set(self.lost_devices)
+        for r in self.replicas:
+            if not r.dead:
+                used.update(r.devices)
+        free = sum(1 for d in range(n_dev) if d not in used)
+        return free // max(1, self._default_width)
 
     def _wire_replica(self, eng, per_cfg) -> Replica:
         """Loop + supervisor + admission + breaker around one engine —
@@ -536,6 +752,9 @@ class ReplicaFleet:
             metrics.FLEET_BREAKER.labels(self.model, str(rep.id)).set(
                 DEAD if rep.dead else rep.breaker.state
             )
+            metrics.FLEET_REPLICA_DEVICES.labels(
+                self.model, str(rep.id)
+            ).set(0 if rep.dead else len(rep.devices))
             if rep.dead:
                 evicted += 1
             elif rep.draining:
@@ -635,6 +854,27 @@ class ReplicaFleet:
         # semantics stay bit-identical).
         self._rebalance()
 
+    def _note_lost_device(self, rep: Replica, exc) -> None:
+        """Map a device-loss fault onto the global device(s) to retire
+        from future carves.  Injected faults name the dead shard
+        (``DeviceLostError.device_index``); a real runtime error that
+        doesn't is attributed to the WHOLE group — honest conservatism:
+        better to strand a maybe-healthy chip than respawn onto a dead
+        one.  Caller holds ``_lock``."""
+        if not rep.devices:
+            return
+        idx = getattr(exc, "device_index", None)
+        if idx is not None and 0 <= int(idx) < len(rep.devices):
+            lost = [rep.devices[int(idx)]]
+        else:
+            lost = list(rep.devices)
+        self.lost_devices.update(lost)
+        log.warning(
+            "replica %d device loss: retiring device(s) %s from the "
+            "carve pool (lost total: %s)",
+            rep.id, lost, sorted(self.lost_devices),
+        )
+
     def _failover_cb(self, rep: Replica):
         """The callback ``streams._evacuate`` invokes with the dead
         replica's stream checkpoints (on the dying loop's thread)."""
@@ -643,6 +883,8 @@ class ReplicaFleet:
             with self._lock:
                 self._mark_dead(rep, cause)
                 self.failovers += 1
+                if cause == "device_lost":
+                    self._note_lost_device(rep, exc)
             metrics.FLEET_FAILOVERS.labels(
                 self.model, str(rep.id), cause
             ).inc()
@@ -772,6 +1014,31 @@ class ReplicaFleet:
             else self.replicas[0].engine
         rid = reuse_id if reuse_id is not None else self._next_id
         per_cfg = self._share_cfg(len(self.live_replicas()) + 1)
+        # Multi-chip: seat the new replica on its own device group
+        # BEFORE building anything.  A rejoin prefers the corpse's old
+        # group (same placement object → compile-free respawn); a
+        # device_lost corpse's group contains a retired chip, so the
+        # carve falls through to fresh devices.  No seatable group →
+        # no spawn, loudly (the governor keeps the hole on its books
+        # and retries as devices free up).
+        group = None
+        width = self._default_width
+        if self.multichip:
+            if replace is not None:
+                width = replace.width
+            prefer = (
+                tuple(replace.devices)
+                if replace is not None and replace.width == width else None
+            )
+            group = self._carve_group(width, prefer)
+            if group is None:
+                log.warning(
+                    "scale-up blocked (replica %d, cause=%s): no free "
+                    "group of %d device(s) (lost=%s)",
+                    rid, cause, width, sorted(self.lost_devices),
+                )
+                self._record_scale("up", "no_devices", rid, t0)
+                return None
         self._spawning = {"replica": rid, "cause": cause}
         self._refresh_gauges()
         # Spin-up latency breakdown (compile vs probe vs rebalance —
@@ -784,8 +1051,14 @@ class ReplicaFleet:
         try:
             with CompileWindow() as cw:
                 t = time.monotonic()
+                if self.multichip:
+                    spawn_bundle = self._bundle_for(width)
+                    spawn_placement = self._placement_for(width, group)
+                else:
+                    spawn_bundle = donor_eng.bundle
+                    spawn_placement = donor_eng.replicas
                 eng = InferenceEngine(
-                    donor_eng.bundle, per_cfg, replicas=donor_eng.replicas,
+                    spawn_bundle, per_cfg, replicas=spawn_placement,
                     replica_id=rid, donor_params=donor_eng.params,
                 )
                 rep = self._wire_replica(eng, per_cfg)
@@ -842,9 +1115,9 @@ class ReplicaFleet:
         self._refresh_gauges()
         log.info(
             "scale-up: replica %d admitted (cause=%s, params=%s, "
-            "%.2fs) — fleet now %d live", rid, cause,
-            rep.engine.params_source, time.monotonic() - t0,
-            len(self.live_replicas()),
+            "devices=%s, %.2fs) — fleet now %d live", rid, cause,
+            rep.engine.params_source, list(rep.devices),
+            time.monotonic() - t0, len(self.live_replicas()),
         )
         return rep
 
@@ -956,11 +1229,16 @@ class ReplicaFleet:
             self._shared_slo.worst_burn()
             if self._shared_slo is not None else 0.0
         )
-        return {
+        snap = {
             "live": len(live), "queued": queued, "active": active,
             "slots": slots, "kv_frac": kv_frac, "ttft_ewma_s": ttft,
             "slo_burn": slo_burn,
         }
+        if self.multichip:
+            # Governor scales in whole groups: an "up" only makes sense
+            # while the host can seat another default-width group.
+            snap["free_groups"] = self._free_group_count()
+        return snap
 
     def scale_tick(self) -> None:
         """One governor period: sweep breaker evictions, rebuild
@@ -1100,6 +1378,16 @@ class ReplicaFleet:
             out["slo"] = self._shared_slo.snapshot()
         return out
 
+    @staticmethod
+    def _mesh_shape(rep: Replica) -> dict:
+        """Per-replica mesh topology for /status.fleet ({} for
+        placement-less duck-typed test engines)."""
+        mesh = getattr(getattr(rep.engine, "replicas", None), "mesh", None)
+        try:
+            return {a: int(n) for a, n in mesh.shape.items()}
+        except Exception:
+            return {}
+
     def status(self) -> dict:
         self.sweep()
         healthy = self.healthy_replicas()
@@ -1110,6 +1398,8 @@ class ReplicaFleet:
             "dead": sum(1 for r in self.replicas if r.dead),
             "degraded": self.degraded,
             "failovers": self.failovers,
+            "multichip": self.multichip,
+            "lost_devices": sorted(self.lost_devices),
             "scaling": self.scaling_status(),
             "per_replica": [
                 {
@@ -1120,6 +1410,9 @@ class ReplicaFleet:
                         "dead" if r.dead else r.breaker.state_name
                     ),
                     "dead_cause": r.dead_cause,
+                    "devices": list(r.devices),
+                    "mesh": self._mesh_shape(r),
+                    "width": r.width,
                     "load": r.load(),
                     "supervisor": r.supervisor.stats(),
                 }

@@ -1091,13 +1091,18 @@ class ContinuousDecodeLoop:
                 if self.failover is not None:
                     # Fleet mode: instead of error-terminating, hand
                     # every live stream's checkpoint to a healthy
-                    # replica for token-identical resume.
-                    cause = (
-                        "budget"
-                        if self.supervisor is not None
-                        and self.supervisor.failed
-                        else "fault"
-                    )
+                    # replica for token-identical resume.  A lost
+                    # device gets its own cause so the fleet can
+                    # retire the chip from future placements.
+                    from .faults import is_device_loss
+
+                    if is_device_loss(e):
+                        cause = "device_lost"
+                    elif (self.supervisor is not None
+                          and self.supervisor.failed):
+                        cause = "budget"
+                    else:
+                        cause = "fault"
                     self._evacuate(e, cause)
                     continue
                 log.exception("decode loop iteration failed")
@@ -1265,6 +1270,17 @@ class ContinuousDecodeLoop:
             # BEFORE deciding recoverability: consecutive faults open
             # the breaker even while the restart budget still grants.
             self.on_fault()
+        if self.failover is not None:
+            from .faults import is_device_loss
+
+            if is_device_loss(exc):
+                # A lost device cannot be rebuilt around: the in-place
+                # restart would re-place params and KV pools onto the
+                # SAME placement, whose dead shard kills every
+                # collective.  Skip the supervisor ladder entirely —
+                # the caller evacuates the whole group to survivors and
+                # the fleet respawns it on healthy devices.
+                return False
         sup = self.supervisor
         if sup is None or not sup.allow_restart():
             # Unrecoverable (no supervisor, or the budget is spent and

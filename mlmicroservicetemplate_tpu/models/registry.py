@@ -313,9 +313,13 @@ def _pallas_backend_ok(svc_cfg) -> bool:
         return False
 
 
-def _tp_placement(svc_cfg, model_cfg, family: str):
+def _tp_placement(svc_cfg, model_cfg, family: str, devices=None):
     """TP=<n> → a TensorParallelSet factory over a ('replica','tp')
     mesh with the family's Megatron param spec; None when TP is off.
+
+    ``devices`` (global device ids) places the group on a specific
+    carve instead of the visible-device prefix — the multi-chip fleet's
+    per-replica placement path (engine/fleet.py).
 
     Mutually exclusive with QUANTIZE: int8 leaves are {"q8","scale"}
     dicts the per-leaf PartitionSpec tree cannot describe.
@@ -337,8 +341,9 @@ def _tp_placement(svc_cfg, model_cfg, family: str):
             f"(num_heads={heads}, kv_heads={kvh}): q/k/v shards and the "
             "KV cache's heads axis split over the 'tp' mesh axis"
         )
-    from ..parallel import TensorParallelSet, make_replica_tp_mesh
+    from ..parallel import TensorParallelSet
     from ..parallel.tp import PARAM_SPECS
+    from ..parallel.tpserve import serving_tp_mesh
 
     spec = PARAM_SPECS[family](model_cfg)
     # REPLICAS=0 (unset) pins the mesh replica axis to 1: TP=<n> claims
@@ -347,8 +352,14 @@ def _tp_placement(svc_cfg, model_cfg, family: str):
     # into a 4x2 DP x TP grid — which the paged block pool rejects
     # (no batch axis to shard) and which the fleet layer already covers
     # with separate engines.  An explicit REPLICAS>1 still composes for
-    # contiguous-KV serving.
-    mesh = make_replica_tp_mesh(tp, int(getattr(svc_cfg, "replicas", 0) or 1))
+    # contiguous-KV serving.  The mesh comes from the serving-mesh
+    # cache (same structural mesh make_replica_tp_mesh built), so the
+    # engine placement and every trace-time shard_map reconstruction
+    # share ONE object per (tp, replicas, devices) — multi-chip fleet
+    # groups pass their carved device ids through ``devices``.
+    mesh = serving_tp_mesh(
+        tp, int(getattr(svc_cfg, "replicas", 0) or 1), group=devices
+    )
     return lambda: TensorParallelSet(mesh, spec)
 
 

@@ -10,7 +10,17 @@ is the small trace-time surface the REST of the serving stack needs:
   STATIC tp width in the model config (model fns are pure; they cannot
   reach the engine's placement object, but the mesh over the first
   ``tp`` visible devices is deterministic and identical to the one
-  ``make_replica_tp_mesh(tp, 1)`` built for the engine).
+  ``make_replica_tp_mesh(tp, 1)`` built for the engine).  Multi-chip
+  fleets place TP groups on NON-prefix device sets (replica 1 on
+  devices (2,3), …): the fleet's executables run under
+  ``use_trace_group`` (runtime/compile_cache.py wraps every shared
+  executable), and ``serving_tp_mesh`` consults that thread-local so a
+  trace on replica 1's thread reconstructs the mesh over replica 1's
+  OWN devices.  The default (prefix) group normalizes to the original
+  cache key, so single-group serving stays byte-identical.
+- ``device_group(placement)`` — a placement's global device-id tuple
+  (None for single-device and default-prefix placements), the value
+  the executable proxies feed ``use_trace_group``.
 - ``kv_head_spec(paged)`` — the one KV-cache layout rule: every cache
   leaf (contiguous ``[B, S, H, D]`` slab, pool ``[NB, BS, H, D]``
   block, or int8 scale ``[..., H]``) shards its HEADS axis (axis 2)
@@ -32,33 +42,117 @@ import threading
 _MESH_CACHE: dict = {}
 _LOCK = threading.Lock()
 
+# Thread-local device group for trace-time mesh reconstruction.  The
+# fleet's executable proxies (runtime/compile_cache._CostedExecutable)
+# set this around every call/lower so model-fn shard_maps traced on a
+# non-prefix replica rebuild the mesh over THAT replica's devices.
+# Thread-local (not a plain global) because the watchdog runs dispatches
+# on fresh daemon threads and two replicas may trace concurrently.
+_TRACE_GROUP = threading.local()
 
-def serving_tp_mesh(tp: int, replicas: int = 1):
-    """Cached ``('replica','tp')`` mesh over the first ``replicas*tp``
-    visible devices — bit-identical (compares/hashes equal) to the
-    engine placement's mesh, so a ``shard_map`` traced against it
-    composes with operands committed by ``TensorParallelSet``."""
+
+def current_trace_group():
+    """The device-id tuple the current thread is tracing for, or None
+    (default prefix placement)."""
+    return getattr(_TRACE_GROUP, "group", None)
+
+
+class use_trace_group:
+    """Context manager pinning ``current_trace_group()`` for this
+    thread.  ``use_trace_group(None)`` is a no-op (keeps the hot
+    single-group path free of save/restore churn)."""
+
+    __slots__ = ("_group", "_prev")
+
+    def __init__(self, group):
+        self._group = tuple(group) if group else None
+        self._prev = None
+
+    def __enter__(self):
+        if self._group is not None:
+            self._prev = getattr(_TRACE_GROUP, "group", None)
+            _TRACE_GROUP.group = self._group
+        return self
+
+    def __exit__(self, *exc):
+        if self._group is not None:
+            _TRACE_GROUP.group = self._prev
+        return False
+
+
+def _normalize_group(group, need: int):
+    """Collapse the default-prefix group to None so prefix placements
+    keep the original (tp, replicas) cache key and mesh object."""
+    if group is None:
+        return None
+    group = tuple(int(g) for g in group)
+    if group == tuple(range(need)):
+        return None
+    return group
+
+
+def serving_tp_mesh(tp: int, replicas: int = 1, group=None):
+    """Cached ``('replica','tp')`` mesh over ``replicas*tp`` devices —
+    bit-identical (compares/hashes equal) to the engine placement's
+    mesh, so a ``shard_map`` traced against it composes with operands
+    committed by ``TensorParallelSet``.
+
+    ``group`` names the global device ids to build over (defaults to
+    the current thread's trace group, else the visible-device prefix).
+    The prefix group normalizes away so single-group serving reuses the
+    exact pre-multichip mesh objects and cache keys."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
-    key = (int(tp), int(replicas))
+    need = int(tp) * int(replicas)
+    if group is None:
+        group = current_trace_group()
+    group = _normalize_group(group, need)
+    key = (int(tp), int(replicas)) if group is None else (
+        int(tp), int(replicas), group)
     with _LOCK:
         mesh = _MESH_CACHE.get(key)
         if mesh is None:
-            need = key[0] * key[1]
             devs = jax.devices()
-            if need > len(devs):
+            if group is not None and len(group) != need:
+                raise ValueError(
+                    f"device group {group} has {len(group)} devices, "
+                    f"TP={tp} x replicas={replicas} needs {need}"
+                )
+            if need > len(devs) or (
+                group is not None and max(group) >= len(devs)
+            ):
                 raise ValueError(
                     f"TP={tp} x replicas={replicas} needs {need} devices, "
                     f"only {len(devs)} visible"
                 )
+            picked = devs[:need] if group is None else [
+                devs[i] for i in group]
             mesh = Mesh(
-                np.array(devs[:need]).reshape(key[1], key[0]),
+                np.array(picked).reshape(int(replicas), int(tp)),
                 ("replica", "tp"),
             )
             _MESH_CACHE[key] = mesh
     return mesh
+
+
+def device_group(placement):
+    """Global device-id tuple of a TP placement, for trace-group
+    pinning.  None for single-device placements, for plain DP meshes
+    (no ``param_spec`` — they never reconstruct a serving mesh), and
+    for the default prefix group (normalized so pre-multichip cache
+    keys stay byte-identical)."""
+    try:
+        mesh = getattr(placement, "mesh", None)
+        if mesh is None or getattr(placement, "param_spec", None) is None:
+            return None
+        ids = tuple(int(d.id) for d in mesh.devices.flat)
+    except Exception:
+        return None
+    if len(ids) <= 1:
+        return None
+    return _normalize_group(ids, len(ids))
 
 
 def kv_head_spec(paged: bool, ndim: int = 4):

@@ -223,19 +223,35 @@ def _sig_item(a: Any) -> Any:
 
 
 class _CostedExecutable:
-    """Call-transparent proxy accruing modeled FLOPs per dispatch."""
+    """Call-transparent proxy accruing modeled FLOPs per dispatch.
 
-    __slots__ = ("_fn", "_kind", "_model", "_costs", "_costs_lock")
+    Also the trace-group pin: an executable built for a non-prefix
+    device group (multi-chip fleet replica) re-enters its group's
+    thread-local around every call/lower, so a model-fn ``shard_map``
+    traced from ANY thread (continuous loop, watchdog daemon, warmers)
+    reconstructs ``serving_tp_mesh`` over the replica's own devices —
+    parallel/tpserve.py.  ``_group is None`` (every single-group
+    serving stack) costs one attribute check per call."""
 
-    def __init__(self, fn: Any, kind: str, model: str):
+    __slots__ = ("_fn", "_kind", "_model", "_costs", "_costs_lock",
+                 "_group")
+
+    def __init__(self, fn: Any, kind: str, model: str, group=None):
         self._fn = fn
         self._kind = kind
         self._model = model
         self._costs: dict = {}
         self._costs_lock = threading.Lock()
+        self._group = group
 
     def __call__(self, *args, **kwargs):
-        out = self._fn(*args, **kwargs)
+        if self._group is not None:
+            from ..parallel.tpserve import use_trace_group
+
+            with use_trace_group(self._group):
+                out = self._fn(*args, **kwargs)
+        else:
+            out = self._fn(*args, **kwargs)
         if perfobs.enabled():
             sig = tuple(_sig_item(a) for a in args)
             c = self._costs.get(sig)
@@ -252,7 +268,14 @@ class _CostedExecutable:
             if len(self._costs) >= MAX_SIGS:
                 return (0.0, 0.0)  # saturated: stop analyzing new sigs
             try:
-                ca = self._fn.lower(*args, **kwargs).cost_analysis()
+                if self._group is not None:
+                    from ..parallel.tpserve import use_trace_group
+
+                    with use_trace_group(self._group):
+                        ca = self._fn.lower(
+                            *args, **kwargs).cost_analysis()
+                else:
+                    ca = self._fn.lower(*args, **kwargs).cost_analysis()
                 if isinstance(ca, (list, tuple)):
                     ca = ca[0] if ca else {}
                 cost = (
@@ -306,7 +329,19 @@ def shared_executable(kind: str, bundle: Any, replicas: Any,
         _COUNTS["miss"] += 1
     metrics.EXEC_CACHE_EVENTS.labels("miss").inc()
     _install_monitor()  # first build turns on compile accounting
-    fn = _CostedExecutable(build(), kind, model)
+    try:
+        from ..parallel.tpserve import device_group, use_trace_group
+
+        grp = device_group(replicas)
+    except Exception:
+        grp = None
+    if grp is not None:
+        # Build (and later call/lower) under the placement's device
+        # group so any eager trace lands on the right mesh.
+        with use_trace_group(grp):
+            fn = _CostedExecutable(build(), kind, model, group=grp)
+    else:
+        fn = _CostedExecutable(build(), kind, model)
     with _LOCK:
         # A racing builder may have inserted meanwhile: last wins is
         # fine (both wrappers are correct; one just goes unshared), but

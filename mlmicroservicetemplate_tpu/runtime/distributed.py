@@ -42,26 +42,50 @@ def broadcast_params(donor_params, replicas):
     """λScale-style scale-up param placement (arXiv 2502.09922): place
     a NEW replica's params from a live donor engine's already-placed
     device arrays instead of re-uploading the checkpoint pytree from
-    host memory.
+    host memory.  Returns ``(placed_params, moved_bytes)``.
 
     ``donor_params`` leaves are committed jax.Arrays (immutable), so:
 
-    - same device / same sharding (the single-device fleet replicas
-      this serves today): ``device_put`` aliases — the spawn pays ZERO
-      param bytes, host or wire;
-    - different devices (per-replica device assignment, the multi-chip
-      follow-up): ``device_put`` of a device-resident array moves it
+    - same devices / same sharding (single-device fleet replicas
+      sharing one placement): ``device_put`` aliases — the spawn pays
+      ZERO param bytes, host or wire, and ``moved_bytes`` is 0 (the
+      engine reports ``params_source="donor-alias"``);
+    - different devices (per-replica device assignment — multi-chip
+      fleets): ``device_put`` of a device-resident array moves it
       device→device over ICI, compiled by the runtime — never back
       through the host, never through a checkpoint read.
+      ``moved_bytes`` counts the destination bytes of every leaf whose
+      device set actually changed (``params_source="donor-ici"``,
+      ``fleet_param_broadcast_bytes_total``).
+
+    The byte count compares SOURCE vs DESTINATION device sets per leaf
+    rather than trusting object identity: ``device_put`` may return a
+    fresh Array object even when it aliased the donor's buffers, so
+    identity would over-report.  Leaves without a ``devices()`` (host
+    arrays in duck-typed tests) count as not-moved — honest negative.
 
     This is the seam the multi-host story extends (one broadcast
-    collective over DCN instead of per-host checkpoint reads); the
-    single-controller serving path only ever hands it single-device
-    placements.  Routing through ``replicas.place_params`` keeps every
-    placement flavor (replicated, tensor-parallel spec trees) correct
-    without duplicating the sharding logic here.
+    collective over DCN instead of per-host checkpoint reads).
+    Routing through ``replicas.place_params`` keeps every placement
+    flavor (replicated, tensor-parallel spec trees) correct without
+    duplicating the sharding logic here.
     """
-    return replicas.place_params(donor_params)
+    placed = replicas.place_params(donor_params)
+    moved = 0
+    try:
+        import jax
+
+        for src, dst in zip(
+            jax.tree.leaves(donor_params), jax.tree.leaves(placed)
+        ):
+            try:
+                if src.devices() != dst.devices():
+                    moved += int(dst.nbytes)
+            except Exception:
+                continue
+    except Exception:
+        pass
+    return placed, moved
 
 
 def maybe_init_distributed(env: dict | None = None) -> bool:

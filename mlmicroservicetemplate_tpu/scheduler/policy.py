@@ -377,29 +377,42 @@ class ScalingGovernor:
     def decide(self, *, live: int, queued: int, active: int,
                slots: int, kv_frac: float = 0.0,
                ttft_ewma_s: float = 0.0,
-               slo_burn: float = 0.0) -> tuple[str | None, str]:
+               slo_burn: float = 0.0,
+               free_groups: int | None = None) -> tuple[str | None, str]:
         """(direction, cause) for one governor tick.  direction is
         "up" | "down" | None; cause labels the scale-event counter
-        (queue | kv | ttft | slo | min | idle | steady)."""
+        (queue | kv | ttft | slo | min | idle | steady | no_devices).
+
+        ``free_groups`` is the multi-chip fleet's group-carve signal:
+        how many whole device groups of the fleet's default width the
+        host can still seat (None — single-device fleets — leaves every
+        decision unchanged).  The governor scales in units of WHOLE
+        groups, so an "up" with ``free_groups == 0`` degrades to
+        ``(None, "no_devices")`` — an honest stall instead of a doomed
+        spawn per tick."""
         now = self._clock()
         if live <= 0:
             # Nothing alive to compare load against: the rejoin path
             # (engine/fleet.py) owns recovery, not the load policy.
             return None, "dead"
+        no_seat = free_groups is not None and free_groups <= 0
         if live < self.min_r:
-            return "up", "min"
+            return (None, "no_devices") if no_seat else ("up", "min")
         up_ready = self._last_up is None or (
             now - self._last_up >= self.up_cooldown_s
         )
         if live < self.max_r and up_ready:
+            want_up = None
             if self.up_queue and queued >= self.up_queue * live:
-                return "up", "queue"
-            if self.up_kv_frac and kv_frac >= self.up_kv_frac:
-                return "up", "kv"
-            if self.up_ttft_s and ttft_ewma_s >= self.up_ttft_s:
-                return "up", "ttft"
-            if self.up_slo_burn and slo_burn >= self.up_slo_burn:
-                return "up", "slo"
+                want_up = "queue"
+            elif self.up_kv_frac and kv_frac >= self.up_kv_frac:
+                want_up = "kv"
+            elif self.up_ttft_s and ttft_ewma_s >= self.up_ttft_s:
+                want_up = "ttft"
+            elif self.up_slo_burn and slo_burn >= self.up_slo_burn:
+                want_up = "slo"
+            if want_up is not None:
+                return (None, "no_devices") if no_seat else ("up", want_up)
         if live > self.min_r:
             survivors = live - 1
             low = (active + queued) <= self.down_load * slots * survivors

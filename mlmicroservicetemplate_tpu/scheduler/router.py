@@ -35,7 +35,12 @@ ROUTE_RR = "rr"
 def replica_load(replica) -> float:
     """Load score: committed KV bytes (normalized to blocks-ish scale)
     + waiting/active stream count.  Works on any object exposing
-    ``cdl`` (queue + active) and an optional admission controller."""
+    ``cdl`` (queue + active) and an optional admission controller.
+
+    Multi-chip fleets divide by the replica's TP width: a TP=2 group
+    owns twice the compute and HBM of a single-device sibling, so the
+    same absolute load leaves it comparatively less full.  Width 1
+    (every pre-multichip replica) keeps the score bit-identical."""
     cdl = replica.cdl
     n = (
         len(cdl.active) + cdl.queue.qsize() + len(cdl._prefilling)
@@ -45,7 +50,7 @@ def replica_load(replica) -> float:
     kv = float(adm.committed_bytes) if adm is not None else 0.0
     # One stream-slot of load per MB committed: coarse, but keeps a
     # KV-heavy replica from looking idle on stream count alone.
-    return n + kv / 1e6
+    return (n + kv / 1e6) / max(1, int(getattr(replica, "width", 1) or 1))
 
 
 class Router:

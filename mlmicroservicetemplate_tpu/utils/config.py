@@ -418,6 +418,15 @@ class ServiceConfig(BaseModel):
     # the rejoin delay: an evicted replica is rebuilt through the
     # scale-up path once it has been dead this long.
     fleet_evict_s: float = 10.0
+    # Multi-chip fleet placement (docs/tensor-parallel.md +
+    # docs/autoscaling.md): comma-separated per-replica TP widths, e.g.
+    # "2,2,1" = two TP=2 groups plus one single-device spare, carved
+    # DISJOINT from the visible device list (replica 0 keeps the base
+    # engine's devices, so the first width must equal TP).  Unset
+    # (default) with TP>1 carves one TP-wide group per replica; unset
+    # with TP=1 keeps the shared single-device placement bit-identical
+    # to the pre-multichip fleet.
+    fleet_tp_groups: str | None = None
 
     # Elastic fleet (docs/autoscaling.md): live autoscaling bounds.
     # FLEET_REPLICAS becomes the INITIAL size; the ScalingGovernor
@@ -869,6 +878,24 @@ class ServiceConfig(BaseModel):
             )
         return v
 
+    @field_validator("fleet_tp_groups")
+    @classmethod
+    def _check_fleet_tp_groups(cls, v: str | None) -> str | None:
+        if v is None or not str(v).strip():
+            return None
+        try:
+            widths = [int(w) for w in str(v).split(",")]
+        except ValueError:
+            raise ValueError(
+                f"FLEET_TP_GROUPS must be comma-separated integer TP "
+                f"widths (e.g. '2,2,1'), got {v!r}"
+            ) from None
+        if not widths or any(not (1 <= w <= 64) for w in widths):
+            raise ValueError(
+                "FLEET_TP_GROUPS widths must each be in [1, 64]"
+            )
+        return ",".join(str(w) for w in widths)
+
     @field_validator("fleet_min_replicas", "fleet_max_replicas")
     @classmethod
     def _check_fleet_bounds_range(cls, v: int) -> int:
@@ -1073,6 +1100,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
       DISPATCH_TIMEOUT_S, DISPATCH_RETRIES, DISPATCH_BACKOFF_S,
       ENGINE_RESTARTS_MAX, ENGINE_RESTART_WINDOW_S, SUPERVISE,
       FLEET_REPLICAS, FLEET_ROUTE, FLEET_BREAKER_N, FLEET_EVICT_S,
+      FLEET_TP_GROUPS,
       FLEET_MIN_REPLICAS, FLEET_MAX_REPLICAS, SCALE_UP_QUEUE,
       SCALE_UP_KV_FRAC, SCALE_UP_TTFT_MS, SCALE_UP_COOLDOWN_S,
       SCALE_DOWN_LOAD, SCALE_DOWN_COOLDOWN_S, SCALE_PERIOD_S,
@@ -1104,6 +1132,7 @@ def load_config(env: dict[str, str] | None = None) -> ServiceConfig:
         "spec_decode": "SPEC_DECODE",
         "priority_default": "PRIORITY_DEFAULT",
         "fleet_route": "FLEET_ROUTE",
+        "fleet_tp_groups": "FLEET_TP_GROUPS",
         "fault_spec": "FAULT_SPEC",
         "log_format": "LOG_FORMAT",
         "profile_dir": "PROFILE_DIR",

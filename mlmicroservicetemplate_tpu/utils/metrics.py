@@ -229,7 +229,8 @@ FLEET_SCALE_EVENTS = Counter(
     "fleet_scale_events_total",
     "Completed fleet scale events by direction and cause (up: queue | "
     "kv | ttft | slo | min | rejoin | manual, spawn_failed when the "
-    "warm probe died; down: idle | manual)",
+    "warm probe died, no_devices when no free device group could seat "
+    "the spawn; down: idle | manual)",
     ["model", "dir", "cause"],
 )
 FLEET_SCALE_DURATION = Histogram(
@@ -245,6 +246,21 @@ FLEET_BREAKER = Gauge(
     "Per-replica circuit breaker state: 0=closed (healthy), "
     "1=half-open (probing), 2=open (routing avoids it), 3=dead "
     "(evicted; streams failed over)",
+    ["model", "replica"],
+)
+FLEET_PARAM_BROADCAST = Counter(
+    "fleet_param_broadcast_bytes_total",
+    "Real param bytes moved device-to-device by donor broadcasts at "
+    "spawn (params_source=donor-ici). Same-placement spawns alias the "
+    "donor's arrays and add ZERO here — the honest-transport ledger "
+    "for multi-chip scale-up (docs/autoscaling.md)",
+    ["model"],
+)
+FLEET_REPLICA_DEVICES = Gauge(
+    "fleet_replica_devices",
+    "Devices owned by each fleet replica's placement (TP group width; "
+    "1 for single-device replicas; 0 once the replica is dead and its "
+    "devices are released or retired)",
     ["model", "replica"],
 )
 CHAIN_DEPTH = Gauge(

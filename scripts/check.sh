@@ -272,6 +272,27 @@ else
     echo "== tensor-parallel smoke skipped (TP_SMOKE=0) =="
 fi
 
+# Multi-chip smoke (docs/fault-tolerance.md device-loss rung): an
+# elastic fleet of TP groups (2,2,1) over the 8 virtual host devices;
+# device_lost fires into shard 1 of replica 0 mid-decode.  The whole
+# TP group must evacuate with ZERO streams lost (every stream
+# token-identical to a solo run, including TP=2 -> TP=1 adoption on a
+# narrower survivor), the lost device must be retired from the carve
+# pool, the governor must respawn on remaining healthy devices, every
+# pool ledger must drain to zero, and a same-placement respawn of the
+# sibling group must record ZERO serve-time XLA compiles (chaos tier,
+# so it stays out of tier-1).  MULTICHIP_SMOKE=0 skips.
+if [ "${MULTICHIP_SMOKE:-1}" != "0" ]; then
+    echo "== multi-chip smoke (TP groups 2,2,1 + r0:chunk:device_lost(1)@4, LOCKTRACE=1) =="
+    timeout -k 10 300 env JAX_PLATFORMS=cpu LOCKTRACE=1 \
+        MULTICHIP_SMOKE_SPEC="${MULTICHIP_SMOKE_SPEC:-r0:chunk:device_lost(1)@4}" \
+        python -m pytest \
+        tests/test_multichip.py::test_multichip_smoke_device_loss \
+        -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+else
+    echo "== multi-chip smoke skipped (MULTICHIP_SMOKE=0) =="
+fi
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,592 @@
+"""Multi-chip elastic fleet tests (ISSUE 19: TP-group replicas,
+device-loss failover, real ICI param broadcast).
+
+The judged contracts:
+
+1. **Carving** — a fleet whose base engine is one TP group (or whose
+   ``FLEET_TP_GROUPS`` names widths) carves the visible device list
+   into DISJOINT per-replica groups: replica 0 keeps the base
+   placement, every other replica gets fresh devices; bad specs
+   (width mismatch, not enough devices) fail at boot, loudly.
+2. **Broadcast honesty** — a scale-up onto a different device group
+   does a real ICI ``device_put`` copy (``params_source ==
+   "donor-ici"``, ``fleet_param_broadcast_bytes_total`` counts the
+   moved bytes) and still reads ZERO checkpoints; same-placement
+   spawns keep reporting ``donor-alias``.
+3. **device_lost** — the new fault kind parses (arg = shard ordinal),
+   fires as ``DeviceLostError``, classifies fatal + device-loss (real
+   ``XlaRuntimeError``-shaped failures too), escalates a TP group
+   straight to evacuation (no in-place rebuild), and the fleet retires
+   the named global device from future carves.
+4. **Coverage matrix** — every fault kind is reachable by injection at
+   every site in ``faults.SITES`` and classifies as the module docs
+   claim (the satellite-2 drift guard).
+5. **Cross-width adoption** — a TP=2 replica's streams resume
+   token-identically on a TP=1 survivor (the checkpoint is
+   placement-agnostic by construction).
+6. The **chaos smoke** (scripts/check.sh MULTICHIP_SMOKE): elastic
+   fleet of TP groups under 8 forced host devices, device_lost into
+   one shard mid-decode → zero streams lost, token identity, ledgers
+   drained, rejoin avoids the lost chip, and a same-placement respawn
+   performs ZERO serve-time XLA compiles (CompileWindow-pinned).
+
+CPU runs force 8 host devices (conftest.py sets
+``--xla_force_host_platform_device_count=8``).
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from helpers import text_feats, tiny_gpt_bundle
+from mlmicroservicetemplate_tpu.engine import InferenceEngine
+from mlmicroservicetemplate_tpu.engine import faults
+from mlmicroservicetemplate_tpu.engine.fleet import (
+    ReplicaFleet,
+    _parse_tp_groups,
+)
+from mlmicroservicetemplate_tpu.parallel import (
+    ReplicaSet,
+    TensorParallelSet,
+    make_mesh,
+)
+from mlmicroservicetemplate_tpu.parallel.tp import gpt_param_spec
+from mlmicroservicetemplate_tpu.parallel.tpserve import (
+    current_trace_group,
+    device_group,
+    serving_tp_mesh,
+    use_trace_group,
+)
+from mlmicroservicetemplate_tpu.scheduler.policy import ScalingGovernor
+from mlmicroservicetemplate_tpu.utils import metrics
+from mlmicroservicetemplate_tpu.utils.config import ServiceConfig, load_config
+
+from test_fleet import _cfg
+from test_streams import _collect, _solo_tokens
+
+
+def _gpt_factory(width: int):
+    """Per-width bundle builder for carved fleets: same seed, so every
+    width serves weight-identical params (tokens match across widths)."""
+    return tiny_gpt_bundle(**({"tp": width} if width > 1 else {}))
+
+
+def _tp_fleet(cfg, **fleet_kw):
+    """TP=2 base engine + fleet, the multi-chip boot shape."""
+    bundle = tiny_gpt_bundle(tp=2)
+    placement = TensorParallelSet(
+        serving_tp_mesh(2, 1), gpt_param_spec(bundle.cfg)
+    )
+    eng = InferenceEngine(bundle, cfg, placement)
+    fleet_kw.setdefault("bundle_factory", _gpt_factory)
+    return bundle, eng, ReplicaFleet(eng, cfg, **fleet_kw)
+
+
+def _run_fleet(fleet, feats_list):
+    async def body():
+        gens = [fleet.submit_stream(dict(f)) for f in feats_list]
+        return await asyncio.gather(
+            *[_collect(g) for g in gens], return_exceptions=True
+        )
+
+    return asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# 1. carving: spec parse, config knob, mesh groups, disjoint placement
+
+
+def test_parse_tp_groups_spec():
+    assert _parse_tp_groups(None) is None
+    assert _parse_tp_groups("") is None
+    assert _parse_tp_groups("2,2,1") == (2, 2, 1)
+    assert _parse_tp_groups("1") == (1,)
+    with pytest.raises(ValueError):
+        _parse_tp_groups("2,0")
+
+
+def test_fleet_tp_groups_config_knob():
+    cfg = load_config({
+        "DEVICE": "cpu", "FLEET_REPLICAS": "2",
+        "FLEET_TP_GROUPS": "2, 2",
+    })
+    assert cfg.fleet_tp_groups == "2,2"
+    assert ServiceConfig(device="cpu").fleet_tp_groups is None
+    for bad in ("2,x", "0,1", "65"):
+        with pytest.raises(Exception):
+            ServiceConfig(device="cpu", fleet_tp_groups=bad)
+
+
+def test_serving_tp_mesh_group_cache_and_normalization():
+    # The default-prefix group collapses onto the original cache key:
+    # same mesh OBJECT, so pre-multichip executables and shard_maps
+    # keep composing bit-identically.
+    base = serving_tp_mesh(2)
+    assert serving_tp_mesh(2, 1, (0, 1)) is base
+    # A non-prefix group builds over ITS devices (and caches).
+    m23 = serving_tp_mesh(2, 1, (2, 3))
+    assert [int(d.id) for d in m23.devices.flat] == [2, 3]
+    assert dict(m23.shape) == {"replica": 1, "tp": 2}
+    assert serving_tp_mesh(2, 1, (2, 3)) is m23
+    # The thread-local trace group redirects group-less reconstruction
+    # (what a model-fn shard_map does at trace time on a fleet thread).
+    assert current_trace_group() is None
+    with use_trace_group((2, 3)):
+        assert current_trace_group() == (2, 3)
+        assert serving_tp_mesh(2) is m23
+    assert current_trace_group() is None
+    with pytest.raises(ValueError):
+        serving_tp_mesh(2, 1, (1, 2, 3))
+    with pytest.raises(ValueError):
+        serving_tp_mesh(2, 1, (6, len(jax.devices())))
+
+
+def test_device_group_of_placements():
+    # Single-device and plain DP placements have no trace group.
+    assert device_group(ReplicaSet(make_mesh(1))) is None
+    b = tiny_gpt_bundle(tp=2)
+    spec = gpt_param_spec(b.cfg)
+    # Default prefix normalizes to None (pre-multichip cache keys).
+    assert device_group(
+        TensorParallelSet(serving_tp_mesh(2, 1), spec)
+    ) is None
+    assert device_group(
+        TensorParallelSet(serving_tp_mesh(2, 1, (4, 5)), spec)
+    ) == (4, 5)
+
+
+def test_fleet_carves_disjoint_groups_and_status():
+    cfg = _cfg(fleet_replicas=3, fleet_tp_groups="2,2,1",
+               max_decode_len=8)
+    _, eng, fleet = _tp_fleet(cfg, autoscale_thread=False)
+    try:
+        assert fleet.multichip
+        devs = [r.devices for r in fleet.replicas]
+        assert devs[0] == (0, 1)  # replica 0 keeps the base placement
+        assert fleet.replicas[0].engine is eng
+        # Disjoint cover, widths as named.
+        flat = [d for g in devs for d in g]
+        assert len(flat) == len(set(flat)) == 5
+        assert [r.width for r in fleet.replicas] == [2, 2, 1]
+        # 3 free devices / default width 2 → one more seatable group.
+        assert fleet._free_group_count() == 1
+        st = fleet.status()
+        assert st["multichip"] is True and st["lost_devices"] == []
+        per = st["per_replica"]
+        assert [tuple(p["devices"]) for p in per] == devs
+        assert per[0]["mesh"] == {"replica": 1, "tp": 2}
+        assert per[2]["width"] == 1
+        # The per-replica device gauge reports each group's size.
+        g = metrics.FLEET_REPLICA_DEVICES.labels("gpt2", "1")
+        assert g._value.get() == 2.0
+    finally:
+        fleet.stop()
+
+
+def test_fleet_rejects_bad_group_specs():
+    bundle = tiny_gpt_bundle(tp=2)
+    spec = gpt_param_spec(bundle.cfg)
+
+    def build(cfg):
+        e = InferenceEngine(
+            bundle, cfg,
+            TensorParallelSet(serving_tp_mesh(2, 1), spec),
+        )
+        return ReplicaFleet(e, cfg, autoscale_thread=False,
+                            bundle_factory=_gpt_factory)
+
+    # One width per replica.
+    with pytest.raises(ValueError, match="one width per replica"):
+        build(_cfg(fleet_replicas=3, fleet_tp_groups="2,2",
+                   max_decode_len=8))
+    # Replica 0 keeps the base placement, so widths[0] must match.
+    with pytest.raises(ValueError, match="base engine's TP width"):
+        build(_cfg(fleet_replicas=2, fleet_tp_groups="1,2",
+                   max_decode_len=8))
+    # 8 visible devices cannot seat 2*5 = 10.
+    with pytest.raises(ValueError, match="only 8 visible"):
+        build(_cfg(fleet_replicas=5, fleet_tp_groups="2,2,2,2,2",
+                   max_decode_len=8))
+
+
+def test_carve_prefers_corpse_group_and_skips_lost_devices():
+    cfg = _cfg(fleet_replicas=2, fleet_tp_groups="2,2", max_decode_len=8)
+    _, _, fleet = _tp_fleet(cfg, autoscale_thread=False)
+    try:
+        rep1 = fleet.replicas[1]
+        assert rep1.devices == (2, 3)
+        rep1.dead = True
+        # A rejoin prefers the corpse's old (now free) group — that is
+        # what keeps the respawn on cached executables.
+        assert fleet._carve_group(2, prefer=rep1.devices) == (2, 3)
+        # A retired chip poisons the preference: carve falls through to
+        # fresh devices.
+        fleet.lost_devices.add(3)
+        assert fleet._carve_group(2, prefer=rep1.devices) == (2, 4)
+        # Not enough healthy devices → None (the governor's honest
+        # "no_devices" stall), never a partial group.
+        fleet.lost_devices.update(range(8))
+        assert fleet._carve_group(2) is None
+        assert fleet._free_group_count() == 0
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# 2. broadcast honesty: real ICI copy across groups, zero checkpoint reads
+
+
+def test_cross_device_scale_up_is_real_ici_broadcast(monkeypatch):
+    from mlmicroservicetemplate_tpu.models import checkpoint as ckpt
+
+    reads = []
+    real_sd, real_pt = ckpt.load_state_dict, ckpt.load_pytree
+    monkeypatch.setattr(
+        ckpt, "load_state_dict",
+        lambda *a, **k: (reads.append("sd"), real_sd(*a, **k))[1],
+    )
+    monkeypatch.setattr(
+        ckpt, "load_pytree",
+        lambda *a, **k: (reads.append("pt"), real_pt(*a, **k))[1],
+    )
+    cfg = _cfg(fleet_replicas=1, fleet_max_replicas=2, max_decode_len=8)
+    _, eng, fleet = _tp_fleet(cfg, autoscale_thread=False)
+    try:
+        counter = metrics.FLEET_PARAM_BROADCAST.labels("gpt2")
+        before = counter._value.get()
+        assert fleet.scale_to(2) == 2
+        new = fleet.replicas[1]
+        # The spawn was seated on its own carved group and its params
+        # came over the interconnect — and honestly say so.
+        assert new.devices == (2, 3) and new.width == 2
+        assert new.engine.params_source == "donor-ici"
+        assert counter._value.get() > before
+        assert reads == [], "cross-device spawn read a checkpoint"
+        # Moved means moved: leaf values identical to the donor's.
+        a = jax.tree.leaves(eng.params)[0]
+        b = jax.tree.leaves(new.engine.params)[0]
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+        )
+        assert {int(d.id) for d in jax.tree.leaves(
+            new.engine.params)[0].devices()} == {2, 3}
+    finally:
+        fleet.stop()
+
+
+def test_governor_no_devices_gate():
+    gov = ScalingGovernor(1, 4, up_queue=1.0)
+    base = dict(live=1, active=0, slots=4, kv_frac=0.0)
+    # Seatable group: normal queue trigger.
+    assert gov.decide(queued=9, free_groups=1, **base) == ("up", "queue")
+    # No seatable group: the up degrades to an honest stall.
+    assert gov.decide(queued=9, free_groups=0, **base) == (
+        None, "no_devices"
+    )
+    # Below min with no devices: still no doomed spawn.
+    gov2 = ScalingGovernor(2, 4, up_queue=1.0)
+    assert gov2.decide(queued=0, free_groups=0, live=1, active=0,
+                       slots=4, kv_frac=0.0) == (None, "no_devices")
+    # Single-device fleets (free_groups=None) are untouched.
+    assert gov2.decide(queued=0, live=1, active=0, slots=4,
+                       kv_frac=0.0) == ("up", "min")
+
+
+# ---------------------------------------------------------------------------
+# 3. device_lost: parse, fire, classify (injected and real shapes)
+
+
+def test_device_lost_spec_parse_and_fire():
+    rules = faults.parse_spec("r0:chunk:device_lost(1)@4")
+    assert len(rules) == 1
+    r = rules[0]
+    assert (r.replica, r.site, r.kind, r.arg, r.nth) == (0, "chunk",
+                                                         "device_lost",
+                                                         1.0, 4)
+    # Bare device_lost defaults to shard 0 (NOT hang's 3600 seconds).
+    assert faults.parse_spec("device_lost@1")[0].arg == 0.0
+    inj = faults.FaultInjector.from_spec("chunk:device_lost(1)@1", seed=0)
+    with pytest.raises(faults.DeviceLostError) as ei:
+        inj.fire("chunk")
+    assert ei.value.device_index == 1
+
+
+def test_device_loss_classification():
+    e = faults.DeviceLostError("injected", device_index=1)
+    assert faults.is_device_loss(e) and faults.is_fatal_device(e)
+    assert not faults.is_transient(e)
+
+    # Real runtimes have no dedicated exception type: classification is
+    # (type name, message) textual — the shapes PJRT/XLA emit.
+    class XlaRuntimeError(Exception):
+        pass
+
+    for msg in (
+        "INTERNAL: device is lost; fix the ICI cabling",
+        "DATA_LOSS: all-reduce failed",
+        "device 3 entered a halt state",
+        "ICI link 2 timed out",
+    ):
+        exc = XlaRuntimeError(msg)
+        assert faults.is_device_loss(exc), msg
+        assert faults.is_fatal_device(exc), msg
+    # Same type, unrelated message: NOT a device loss (a shape error
+    # must not evacuate a healthy group).
+    assert not faults.is_device_loss(XlaRuntimeError("invalid shape"))
+    # Right message, wrong type: ordinary exceptions never classify.
+    assert not faults.is_device_loss(ValueError("device is lost"))
+
+
+# ---------------------------------------------------------------------------
+# 4. coverage matrix: every kind reachable at every site, classified as
+#    documented (satellite-2 drift guard)
+
+
+@pytest.mark.parametrize("site", [s for s in faults.SITES if s != "*"])
+@pytest.mark.parametrize("kind", faults.KINDS)
+def test_fault_kind_reachable_at_every_site(site, kind):
+    arg = {"hang": "(0.05)", "device_lost": "(1)"}.get(kind, "")
+    inj = faults.FaultInjector.from_spec(f"{site}:{kind}{arg}@1", seed=0)
+    # Site scoping: a dispatch at ANOTHER site never trips the rule.
+    other = "chunk" if site != "chunk" else "fetch"
+    inj.fire(other)
+    if kind == "hang":
+        t0 = time.monotonic()
+        inj.fire(site)  # sleeps through the (tiny) injected hang
+        assert time.monotonic() - t0 >= 0.04
+        return
+    with pytest.raises(Exception) as ei:
+        inj.fire(site)
+    e = ei.value
+    if kind == "transient":
+        assert isinstance(e, faults.TransientDeviceError)
+        assert faults.is_transient(e) and not faults.is_fatal_device(e)
+    elif kind == "fatal":
+        assert isinstance(e, faults.FatalDeviceError)
+        assert faults.is_fatal_device(e) and not faults.is_device_loss(e)
+    elif kind == "device_lost":
+        assert isinstance(e, faults.DeviceLostError)
+        assert e.device_index == 1
+        assert faults.is_fatal_device(e) and faults.is_device_loss(e)
+    else:  # oob
+        from mlmicroservicetemplate_tpu.engine.kv_blocks import OutOfBlocks
+
+        assert isinstance(e, OutOfBlocks)
+
+
+def test_wildcard_site_fires_everywhere():
+    inj = faults.FaultInjector.from_spec("*:transient@1+99", seed=0)
+    for site in faults.SITES:
+        if site == "*":
+            continue
+        with pytest.raises(faults.TransientDeviceError):
+            inj.fire(site)
+
+
+# ---------------------------------------------------------------------------
+# 5. device-loss failover: group evacuation + cross-width adoption
+
+
+def test_device_loss_evacuates_group_onto_narrower_survivor():
+    """A device_lost into shard 1 of the TP=2 replica 0 evacuates the
+    WHOLE group (no in-place rebuild — the placement has a dead chip),
+    its streams resume token-identically on the TP=1 replica 1, and
+    the fleet retires global device 1 from the carve pool."""
+    cfg = _cfg(
+        fleet_replicas=2, fleet_tp_groups="2,1", max_streams=2,
+        max_stream_queue=16,
+        max_decode_len=12, fault_spec="r0:chunk:device_lost(1)@2",
+        engine_restarts_max=2,
+    )
+    bundle, _, fleet = _tp_fleet(cfg, autoscale_thread=False)
+    ref = InferenceEngine(
+        tiny_gpt_bundle(), _cfg(max_decode_len=12), ReplicaSet(make_mesh(1))
+    )
+    texts = ["abc", "hello world stream", "xy", "some mid-size text",
+             "more text", "last one"]
+    feats = [text_feats(bundle.tokenizer, t) for t in texts]
+    solos = [_solo_tokens(ref, f) for f in feats]
+    try:
+        outs = _run_fleet(fleet, feats)
+        lost = [o for o in outs if isinstance(o, BaseException)]
+        assert not lost, f"streams lost across the device loss: {lost}"
+        for got, want in zip(outs, solos):
+            n = min(len(got), len(want))
+            np.testing.assert_array_equal(got[:n], want[:n])
+            assert not np.any(want[n:] != 0) and not np.any(got[n:] != 0)
+        r0 = fleet.replicas[0]
+        assert r0.dead and r0.dead_cause == "device_lost"
+        assert fleet.failovers == 1
+        # Shard 1 of group (0, 1) is global device 1 — retired.
+        assert fleet.lost_devices == {1}
+        st = fleet.status()
+        assert st["lost_devices"] == [1]
+        assert st["per_replica"][0]["breaker"] == "dead"
+        # The supervisor never burned a restart on the lost device (the
+        # escalation skips the in-place ladder entirely).
+        assert r0.supervisor.stats()["restarts"] == 0
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# 6. chaos tier: the acceptance scenario (scripts/check.sh MULTICHIP_SMOKE)
+
+
+@pytest.mark.chaos
+def test_multichip_smoke_device_loss():
+    """End to end with the REAL scaler thread on 8 forced host devices:
+    an elastic fleet of TP groups (2,2,1), device_lost into shard 1 of
+    replica 0 mid-decode → zero streams lost, every stream
+    token-identical to a solo run (including TP=2 → TP=1 adoption),
+    every pool ledger drains, the governor respawns replica 0 on fresh
+    devices AVOIDING the lost chip, and a same-placement respawn of the
+    sibling TP group performs ZERO serve-time XLA compiles."""
+    from mlmicroservicetemplate_tpu.scheduler.policy import QueueFullError
+
+    spec = os.environ.get(
+        "MULTICHIP_SMOKE_SPEC", "r0:chunk:device_lost(1)@4"
+    )
+    cfg = _cfg(
+        fleet_replicas=3, fleet_min_replicas=2, fleet_max_replicas=3,
+        fleet_tp_groups="2,2,1",
+        scale_period_s=0.05, scale_up_cooldown_s=0.2,
+        scale_down_cooldown_s=60.0, fleet_evict_s=1.0,
+        max_streams=2, max_stream_queue=16,
+        paged_kv=True, kv_block_size=8, max_decode_len=32,
+        seq_buckets=(16, 32), fault_spec=spec,
+        engine_restarts_max=0, drain_grace_s=5.0,
+    )
+    bundle, _, fleet = _tp_fleet(cfg)  # real governor thread
+    ref = InferenceEngine(
+        tiny_gpt_bundle(),
+        _cfg(max_decode_len=32, seq_buckets=(16, 32)),
+        ReplicaSet(make_mesh(1)),
+    )
+    prompts = [
+        "the quick brown fox", "pack my box", "jinxed wizards",
+        "five dozen jugs", "sphinx of black quartz", "judge my vow",
+    ]
+    feats = [text_feats(bundle.tokenizer, t) for t in prompts]
+    solos = [_solo_tokens(ref, f) for f in feats]
+    try:
+        # The r0 schedule must land ONCE: the moment the kill shows up,
+        # clear the spec so respawned replicas get clean injectors.
+        def clear_spec_after_kill():
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if fleet.failovers >= 1:
+                    fleet.cfg = fleet.cfg.model_copy(
+                        update={"fault_spec": None}
+                    )
+                    return
+                time.sleep(0.02)
+
+        watcher = threading.Thread(
+            target=clear_spec_after_kill, daemon=True
+        )
+        watcher.start()
+
+        async def body():
+            outs, wants = [], []
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline and fleet.failovers == 0:
+                gens = []
+                for f, want in zip(feats, solos):
+                    try:
+                        gens.append(fleet.submit_stream(dict(f)))
+                        wants.append(want)
+                    except QueueFullError:
+                        pass  # shed (degraded race) ≠ lost
+                outs += list(await asyncio.gather(
+                    *[_collect(g) for g in gens], return_exceptions=True
+                ))
+            return outs, wants
+
+        outs, wants = asyncio.run(body())
+        assert fleet.failovers >= 1, "the r0 device_lost never landed"
+        lost = [o for o in outs if isinstance(o, BaseException)]
+        assert not lost, f"streams lost across the device loss: {lost}"
+        for got, want in zip(outs, wants):
+            n = min(len(got), len(want))
+            np.testing.assert_array_equal(got[:n], want[:n])
+            assert not np.any(want[n:] != 0) and not np.any(got[n:] != 0)
+        assert len(fleet.lost_devices) >= 1
+        # The governor rebuilds the dead group FLEET_EVICT_S later —
+        # on devices that EXCLUDE every retired chip.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if not any(r.dead for r in fleet.replicas):
+                break
+            time.sleep(0.05)
+        assert not any(r.dead for r in fleet.replicas), (
+            "governor never replaced the dead group",
+            fleet.status()["scaling"],
+        )
+        assert fleet._scale_counts.get("up:rejoin", 0) >= 1
+        r0 = next(r for r in fleet.replicas if r.id == 0)
+        assert r0.width == 2 and len(r0.devices) == 2
+        assert not set(r0.devices) & fleet.lost_devices, (
+            r0.devices, fleet.lost_devices
+        )
+        # Ledger hygiene: every pool in the final roster drains.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(
+                r.engine.kv_pool.used_blocks == 0 for r in fleet.replicas
+            ):
+                break
+            time.sleep(0.05)
+        for rep in fleet.replicas:
+            assert rep.engine.kv_pool.used_blocks == 0, (
+                rep.id, rep.engine.kv_pool.stats()
+            )
+        # Same-placement respawn pin: kill the intact TP=2 sibling
+        # (whole group = one replica for eviction too) and let the
+        # governor rebuild it — the carve prefers the corpse's own
+        # (healthy, free) group, the placement cache returns the SAME
+        # object, so the respawn hits cached executables: ZERO XLA
+        # compiles inside the spawn's CompileWindow.
+        rep1 = next(r for r in fleet.replicas if r.id == 1)
+        # Boot replicas never run the spawn probe, so its unary-start
+        # executable is not yet cached for this group: dispatch it once
+        # HERE (a governor-spawned replica would have paid this at its
+        # own first spawn), so the respawn window below measures the
+        # respawn's serve-time compiles only.
+        fleet._probe(rep1)
+        old_devices = tuple(rep1.devices)
+        t = rep1.cdl._thread
+        if t is not None and t.is_alive() and not rep1.cdl.dead:
+            rep1.cdl.request_evacuation("evicted")
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not rep1.dead:
+                time.sleep(0.02)
+        else:
+            with fleet._lock:
+                fleet._mark_dead(rep1, "evicted")
+        assert rep1.dead
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            cur = next(r for r in fleet.replicas if r.id == 1)
+            if not cur.dead:
+                break
+            time.sleep(0.05)
+        cur = next(r for r in fleet.replicas if r.id == 1)
+        assert not cur.dead, ("replica 1 never rejoined",
+                              fleet.status()["scaling"])
+        assert tuple(cur.devices) == old_devices
+        ev = [
+            e for e in fleet._scale_events
+            if e["dir"] == "up" and e["cause"] == "rejoin"
+            and e["replica"] == 1
+        ]
+        assert ev, fleet.status()["scaling"]
+        assert ev[-1]["breakdown"]["xla_compiles"] == 0, ev[-1]
+    finally:
+        fleet.stop()

@@ -6,7 +6,9 @@ engine/fleet.py scale machinery; docs/autoscaling.md):
    hysteresis, and the [min, max] bounds.
 2. Scale-UP via donor-param broadcast: a spawned replica's params come
    from a live donor's already-placed device arrays — ZERO checkpoint
-   reads (counted), ``params_source == "donor"`` — and it joins routing
+   reads (counted), ``params_source == "donor-alias"`` (shared
+   placement: the broadcast honestly reports the alias) — and it joins
+   routing
    only after the warm probe dispatch; streams across the grown fleet
    stay token-identical to solo runs.
 3. Scale-DOWN: a clean drain retires an idle replica with zero
@@ -169,8 +171,10 @@ def test_scale_up_donor_broadcast_no_checkpoint_reload(monkeypatch):
         assert fleet.scale_to(3, cause="manual") == 3
         assert reads == [], "scale-up read a checkpoint"
         assert [r.id for r in fleet.replicas] == [0, 1, 2]
+        # Single-device fleet: every spawn shares the donor's placement,
+        # so the broadcast honestly reports the alias (no bytes moved).
         assert [r.engine.params_source for r in fleet.replicas] == [
-            "host", "donor", "donor"
+            "host", "donor-alias", "donor-alias"
         ]
         # Every replica is routable (the probes succeeded) and serves
         # token-identically to a solo reference.
@@ -432,7 +436,7 @@ def test_evicted_replica_rejoins_with_restored_share():
         new = fleet.replicas[1]
         assert new is not r1
         assert new.id == 1 and new.healthy()
-        assert new.engine.params_source == "donor"
+        assert new.engine.params_source == "donor-alias"
         # The budget share is restored: an even two-way split again.
         shares = [
             r.admission.kv_budget_bytes for r in fleet.live_replicas()
